@@ -1,0 +1,30 @@
+"""janus-tpu: a TPU-native Byzantine-fault-tolerant serializable CRDT framework.
+
+A ground-up redesign of the Reliable-CRDT system from MSRG/Janus-CRDT
+("Making CRDTs Not So Eventual", PVLDB) for TPU hardware:
+
+- CRDT lattice state lives in fixed-shape device tensors
+  (replicas x keys x clock/tag slots) instead of per-object dictionaries
+  (reference: MergeSharp/MergeSharp/CRDTs/*.cs).
+- Merges are batched lattice-join kernels (elementwise max, sorted slot-set
+  union, vector-clock dominance) vmapped over keys and replicas
+  (reference hot loop: PNCounters.cs:131-144, 52.3% of server CPU).
+- DAG (Narwhal) + Tusk consensus is a synchronous tensor program over
+  boolean ack/cert/reference matrices (reference: BFT-CRDT/DAGConsensus/).
+- Replica-to-replica deltas ride XLA collectives over a jax.sharding.Mesh
+  (ICI/DCN) instead of full-mesh TCP gossip
+  (reference: MergeSharp.TCPConnectionManager/, BFT-CRDT/Network/).
+
+Subpackages
+-----------
+ops        pure lattice-join kernels (jnp + pallas)
+models     CRDT data types (PNCounter, ORSet, LWWSet, TPSet, MVRegister, graph)
+parallel   mesh construction, sharded multi-replica execution
+consensus  DAG mempool + Tusk wave commit as tensor programs
+runtime    replicated store, SafeCRDT dual-state runtime, engine
+net        client wire protocol + host sidecar
+bench      workload generators and benchmark harness
+utils      config, id interning, perf counters
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,96 @@
+"""CRDT type model contract + registry.
+
+The analog of the reference's type seam: the abstract ``CRDT`` contract
+(MergeSharp/MergeSharp/CRDTBase.cs:40-80), the per-type op-dispatch wrappers
+(BFT-CRDT/SafeCRDTs/PNCounterWrapper.cs:33-48, ORSetWrapper.cs:30-47) and the
+``SafeCRDTManager.TypeMap`` registry (SafeCRDTManager.cs:20-23).
+
+A *type model* here is a set of pure functions over a fixed-shape state
+pytree covering a whole key space at once (K keys), not one object:
+
+- ``init(num_keys, **dims) -> state``
+- ``apply_ops(state, ops) -> state``   batched local update application
+- ``merge(a, b) -> state``             the lattice join (anti-entropy kernel)
+- type-specific query functions
+
+Ops travel as a uniform structure-of-arrays record so the command layer,
+consensus payloads, and workload generators can all speak one schema
+(the tensor analog of the reference's ClientMessage/CRDTCommand,
+BFT-CRDT/Network/ClientMessages.cs:13-34).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict
+
+import jax.numpy as jnp
+
+# Uniform op record fields. op == 0 is reserved padding (no-op).
+OP_NOOP = 0
+OP_FIELDS = ("op", "key", "a0", "a1", "a2", "writer")
+
+OpBatch = Dict[str, jnp.ndarray]  # each field: i32[B]
+
+
+def make_op_batch(
+    op=None, key=None, a0=None, a1=None, a2=None, writer=None, batch: int | None = None
+) -> OpBatch:
+    """Build a dense op batch; missing fields are zero-filled."""
+    given = {"op": op, "key": key, "a0": a0, "a1": a1, "a2": a2, "writer": writer}
+    sizes = [len(v) for v in given.values() if v is not None]
+    n = batch if batch is not None else (sizes[0] if sizes else 0)
+    out = {}
+    for f in OP_FIELDS:
+        v = given[f]
+        out[f] = (
+            jnp.zeros((n,), jnp.int32)
+            if v is None
+            else jnp.asarray(v, jnp.int32)
+        )
+    return out
+
+
+def pad_op_batch(ops: OpBatch, to: int) -> OpBatch:
+    """Pad an op batch with no-ops up to a static size ``to``."""
+    n = ops["op"].shape[0]
+    if n == to:
+        return ops
+    if n > to:
+        raise ValueError(f"op batch of {n} exceeds static size {to}")
+    return {f: jnp.pad(ops[f], (0, to - n)) for f in OP_FIELDS}
+
+
+@dataclasses.dataclass(frozen=True)
+class CRDTTypeSpec:
+    """One replicated type: its state constructor, op application, join,
+    and named queries. ``type_code`` matches the reference wire codes
+    ('pnc' | 'orset' | ..., CommandController.cs:13-26)."""
+
+    name: str
+    type_code: str
+    init: Callable[..., Any]
+    apply_ops: Callable[[Any, OpBatch], Any]
+    merge: Callable[[Any, Any], Any]
+    queries: Dict[str, Callable]
+    op_codes: Dict[str, int]  # wire opCode letter -> op id (CmdParser.cs:12-16)
+
+
+_REGISTRY: Dict[str, CRDTTypeSpec] = {}
+
+
+def register_type(spec: CRDTTypeSpec) -> CRDTTypeSpec:
+    """Register a type model (ReplicationManager.RegisterType analog,
+    ReplicationManager.cs:204-254). Idempotent per type_code."""
+    existing = _REGISTRY.get(spec.type_code)
+    if existing is not None and existing is not spec:
+        raise ValueError(f"type code {spec.type_code!r} already registered")
+    _REGISTRY[spec.type_code] = spec
+    return spec
+
+
+def get_type(type_code: str) -> CRDTTypeSpec:
+    return _REGISTRY[type_code]
+
+
+def registered_types() -> Dict[str, CRDTTypeSpec]:
+    return dict(_REGISTRY)

@@ -1,0 +1,135 @@
+"""Last-Writer-Wins element set over per-element timestamp slots.
+
+Reference: MergeSharp/MergeSharp/CRDTs/LWWSet.cs — ``Dictionary<T,DateTime>``
+add/remove stamp maps; Add upserts the add stamp (:148-160), Remove only
+records a stamp when the element is currently contained (:168-191), lookup
+favours add on stamp ties (LookupAll, :210-231 "favours add in case of a
+tie"), merge takes the per-element max of both maps (ApplySynchronizedUpdate).
+
+Tensor design: per key, E slots of (elem, add_hi/add_lo, rm_hi/rm_lo).
+Timestamps are 64-bit split into int32 (hi, lo) lanes with unsigned-low
+lexicographic order (ops.lattice.ts_after); "never stamped" is
+(TS_NONE_HI, 0) which orders below every real stamp. The join is the
+sorted slot-union with pairwise ts-max fold.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+from jax import lax
+
+from janus_tpu.models import base
+from janus_tpu.ops import make_slots, row_upsert, slot_union, ts_after, ts_max
+
+OP_ADD = 1
+OP_REMOVE = 2
+
+TS_NONE_HI = jnp.iinfo(jnp.int32).min  # sorts below any real stamp (hi >= 0)
+
+KEY_FIELDS = ("elem",)
+State = Dict[str, jnp.ndarray]
+
+
+def init(num_keys: int, capacity: int) -> State:
+    s = make_slots(
+        capacity,
+        {"elem": jnp.int32, "add_hi": jnp.int32, "add_lo": jnp.int32,
+         "rm_hi": jnp.int32, "rm_lo": jnp.int32},
+    )
+    for f in ("add_hi", "rm_hi"):
+        s[f] = jnp.full_like(s[f], TS_NONE_HI)
+    for f in ("add_lo", "rm_lo"):
+        s[f] = jnp.zeros_like(s[f])
+    return {f: jnp.broadcast_to(v, (num_keys,) + v.shape).copy() for f, v in s.items()}
+
+
+def _combine(p, q):
+    """Duplicate elem fold: per-polarity lexicographic timestamp max."""
+    add_hi, add_lo = ts_max(p["add_hi"], p["add_lo"], q["add_hi"], q["add_lo"])
+    rm_hi, rm_lo = ts_max(p["rm_hi"], p["rm_lo"], q["rm_hi"], q["rm_lo"])
+    return {"add_hi": add_hi, "add_lo": add_lo, "rm_hi": rm_hi, "rm_lo": rm_lo}
+
+
+def _slot_live(valid, add_hi, add_lo, rm_hi, rm_lo):
+    """Contained: has an add stamp and add >= remove (add wins ties)."""
+    return valid & (add_hi != TS_NONE_HI) & ts_after(add_hi, add_lo, rm_hi, rm_lo)
+
+
+def apply_ops(state: State, ops: base.OpBatch) -> State:
+    """add: a0=elem, a1=ts_hi, a2=ts_lo — upsert add stamp (max fold).
+    remove: same args — stamps only if the element is currently contained,
+    matching the reference's effect-gated Remove."""
+
+    def step(st, op):
+        k = op["key"]
+        row = {f: st[f][k] for f in st}
+        en = op["op"] != base.OP_NOOP
+        is_add = en & (op["op"] == OP_ADD)
+        is_rm = en & (op["op"] == OP_REMOVE)
+
+        hit = row["valid"] & (row["elem"] == op["a0"])
+        contained = jnp.any(
+            _slot_live(hit, row["add_hi"], row["add_lo"], row["rm_hi"], row["rm_lo"])
+        )
+
+        def upsert(payload, enabled):
+            return row_upsert(
+                row, KEY_FIELDS, (op["a0"],), payload,
+                lambda old, new: _combine(old, new), enabled=enabled,
+            )
+
+        added = upsert(
+            {"add_hi": op["a1"], "add_lo": op["a2"],
+             "rm_hi": TS_NONE_HI, "rm_lo": jnp.int32(0)},
+            is_add,
+        )
+        removed = upsert(
+            {"add_hi": TS_NONE_HI, "add_lo": jnp.int32(0),
+             "rm_hi": op["a1"], "rm_lo": op["a2"]},
+            is_rm & contained,
+        )
+        new_row = {f: jnp.where(is_add, added[f], removed[f]) for f in row}
+        st = {f: st[f].at[k].set(new_row[f]) for f in st}
+        return st, None
+
+    state, _ = lax.scan(step, state, ops)
+    return state
+
+
+def merge(a: State, b: State) -> State:
+    cap = a["elem"].shape[-1]
+    out, _ = slot_union(a, b, KEY_FIELDS, _combine, capacity=cap)
+    return out
+
+
+def contains(state: State, key, elem) -> jnp.ndarray:
+    hit = state["valid"][key] & (state["elem"][key] == elem)
+    return jnp.any(
+        _slot_live(hit, state["add_hi"][key], state["add_lo"][key],
+                   state["rm_hi"][key], state["rm_lo"][key]),
+        axis=-1,
+    )
+
+
+def lookup_mask(state: State) -> jnp.ndarray:
+    """[..., K, E] mask of contained slots (one slot per element)."""
+    return _slot_live(state["valid"], state["add_hi"], state["add_lo"],
+                      state["rm_hi"], state["rm_lo"])
+
+
+def live_count(state: State) -> jnp.ndarray:
+    return jnp.sum(lookup_mask(state), axis=-1)
+
+
+SPEC = base.register_type(
+    base.CRDTTypeSpec(
+        name="LWWSet",
+        type_code="lww",
+        init=init,
+        apply_ops=apply_ops,
+        merge=merge,
+        queries={"contains": contains, "live_count": live_count},
+        op_codes={"a": OP_ADD, "r": OP_REMOVE},
+    )
+)

@@ -1,0 +1,162 @@
+"""Observed-Remove Set over fixed-capacity tag-slot tensors.
+
+Reference: MergeSharp/MergeSharp/CRDTs/ORSet.cs — per-element add-tag and
+remove-tag GUID sets; Add mints a fresh GUID (:134-153), Remove copies the
+observed add-tags into the remove set (:161-186), element present iff it has
+an add-tag not yet in the remove set (LookupAll, :204-227), merge is
+per-element union of both tag maps (:253-283).
+
+Tensor design: per key a block of C slots, each slot one tag —
+``tag_rep``/``tag_ctr`` (the 64-bit unique tag as two int32 lanes: minting
+replica x per-replica counter), ``elem`` (interned element id), and a
+``removed`` tombstone bit standing for "this tag is in the remove set".
+Presence(e) = any(valid & ~removed & elem==e). The join is the sorted
+slot-union kernel with tombstone-OR fold — per-key hash walks become one
+batched sort over (replicas x keys x slots).
+
+Deviations from the reference, by design:
+- ``Clear`` tombstones all observed tags instead of erasing state
+  (ORSet.cs:192-198 destructively clears, which cannot propagate through a
+  union join and silently resurrects on the next merge; tombstoning is the
+  observed-remove-correct clear).
+- Unbounded tag growth (196 MB messages, paper §6.2) is replaced by fixed
+  capacity + ``compact`` at coordination points (the principled version of
+  the benchmark's 50-element reset hack, ORSetWorkload.cs:50-63).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+from jax import lax
+
+from janus_tpu.models import base
+from janus_tpu.ops import SENTINEL, make_slots, row_insert, slot_union
+
+OP_ADD = 1    # reference opId 1 = Add (ORSetWrapper.cs:30-47)
+OP_REMOVE = 2
+OP_CLEAR = 3
+
+KEY_FIELDS = ("tag_rep", "tag_ctr")
+State = Dict[str, jnp.ndarray]  # fields [..., K, C]; "valid" mask included
+
+
+def init(num_keys: int, capacity: int) -> State:
+    s = make_slots(
+        capacity,
+        {"tag_rep": jnp.int32, "tag_ctr": jnp.int32, "elem": jnp.int32,
+         "removed": jnp.bool_},
+    )
+    return {f: jnp.broadcast_to(v, (num_keys,) + v.shape).copy() for f, v in s.items()}
+
+
+def _combine(p, q):
+    """Duplicate tag fold: tombstone is sticky, elem is tag-determined."""
+    return {"removed": p["removed"] | q["removed"], "elem": p["elem"]}
+
+
+def apply_ops(state: State, ops: base.OpBatch) -> State:
+    """Apply add/remove/clear ops sequentially (lax.scan) — adds need a
+    fresh slot each, so within-batch ordering matters, exactly like the
+    reference's per-object lock serialization (ORSetCommand.cs).
+
+    add:    a0=elem, a1=tag_rep, a2=tag_ctr (host mints unique tags)
+    remove: a0=elem  (tombstones the currently observed tags of elem)
+    clear:  tombstones every observed tag
+    """
+
+    def step(st, op):
+        k = op["key"]
+        row = {f: st[f][k] for f in st}
+        en = op["op"] != base.OP_NOOP
+
+        added = row_insert(
+            row,
+            {"tag_rep": op["a1"], "tag_ctr": op["a2"], "elem": op["a0"],
+             "removed": jnp.bool_(False)},
+            enabled=en & (op["op"] == OP_ADD),
+        )
+        rm_mask = row["valid"] & (row["elem"] == op["a0"])
+        clear_mask = row["valid"]
+        tomb = jnp.where(
+            en & (op["op"] == OP_REMOVE),
+            rm_mask,
+            jnp.where(en & (op["op"] == OP_CLEAR), clear_mask, False),
+        )
+        new_row = {f: added[f] for f in row}
+        new_row["removed"] = added["removed"] | tomb
+        st = {f: st[f].at[k].set(new_row[f]) for f in st}
+        return st, None
+
+    state, _ = lax.scan(step, state, ops)
+    return state
+
+
+def merge(a: State, b: State) -> State:
+    out, _ = merge_with_stats(a, b)
+    return out
+
+
+def merge_with_stats(a: State, b: State):
+    """Join = per-key union of tag slots; returns (state, overflow[..., K])."""
+    cap = a["tag_rep"].shape[-1]
+    return slot_union(a, b, KEY_FIELDS, _combine, capacity=cap)
+
+
+def contains(state: State, key, elem) -> jnp.ndarray:
+    """Presence: some observed add-tag of elem is not tombstoned
+    (the tensor form of LookupAll's add-minus-remove set algebra)."""
+    row_valid = state["valid"][key]
+    row_elem = state["elem"][key]
+    row_rm = state["removed"][key]
+    return jnp.any(row_valid & ~row_rm & (row_elem == elem), axis=-1)
+
+
+def lookup_mask(state: State) -> jnp.ndarray:
+    """[..., K, C] mask of live (add-surviving) slots; unique elems of the
+    masked ``elem`` field are the set contents."""
+    return state["valid"] & ~state["removed"]
+
+
+def live_count(state: State) -> jnp.ndarray:
+    """Number of live tags per key (upper bound on set cardinality)."""
+    return jnp.sum(lookup_mask(state), axis=-1)
+
+
+def compact(state: State) -> State:
+    """Drop tombstoned slots to reclaim capacity.
+
+    Only safe at coordination points where every replica has observed the
+    tombstones (e.g. after a consensus commit applies to the stable state)
+    — otherwise a lagging replica's merge could resurrect the tag.
+    """
+    keep = state["valid"] & ~state["removed"]
+    rank = (~keep).astype(jnp.int32)
+    ops = (
+        rank,
+        jnp.where(keep, state["tag_rep"], SENTINEL),
+        jnp.where(keep, state["tag_ctr"], SENTINEL),
+        state["elem"],
+        state["removed"] & keep,
+        keep,
+    )
+    rank_s, rep, ctr, elem, removed, valid = lax.sort(
+        ops, dimension=-1, num_keys=1, is_stable=True
+    )
+    del rank_s
+    return {"tag_rep": rep, "tag_ctr": ctr, "elem": elem,
+            "removed": removed, "valid": valid}
+
+
+SPEC = base.register_type(
+    base.CRDTTypeSpec(
+        name="ORSet",
+        type_code="orset",
+        init=init,
+        apply_ops=apply_ops,
+        merge=merge,
+        queries={"contains": contains, "live_count": live_count},
+        # wire opCodes: a=add, r=remove, c=clear (ORSetCommand.cs:13-87)
+        op_codes={"a": OP_ADD, "r": OP_REMOVE, "c": OP_CLEAR},
+    )
+)

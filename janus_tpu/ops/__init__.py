@@ -1,0 +1,43 @@
+"""Pure lattice-join kernels — the compute core of the framework.
+
+Replaces the reference's per-dictionary sequential merges
+(reference: MergeSharp/MergeSharp/CRDTs/PNCounters.cs:131-144,
+ORSet.cs:253-283, LWWSet.cs:255-300, MVRegister.cs:168-206) with
+fixed-shape, batched tensor kernels that XLA can tile onto the VPU/MXU.
+"""
+
+from janus_tpu.ops.lattice import (
+    SENTINEL,
+    join_max,
+    join_or,
+    clock_leq,
+    clock_dominates,
+    clock_compare,
+    ts_after,
+    ts_max,
+)
+from janus_tpu.ops.setops import (
+    slot_union,
+    row_find,
+    row_first_free,
+    row_upsert,
+    row_insert,
+    make_slots,
+)
+
+__all__ = [
+    "SENTINEL",
+    "join_max",
+    "join_or",
+    "clock_leq",
+    "clock_dominates",
+    "clock_compare",
+    "ts_after",
+    "ts_max",
+    "slot_union",
+    "row_find",
+    "row_first_free",
+    "row_upsert",
+    "row_insert",
+    "make_slots",
+]

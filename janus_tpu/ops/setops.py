@@ -1,0 +1,197 @@
+"""Fixed-capacity slot-set tensors and their sorted-union join.
+
+The reference stores CRDT sets as ``Dictionary<T, HashSet<Guid>>`` and merges
+them with nested hash-walks (ORSet.cs:253-283, LWWSet.cs:255-300,
+2P-Set.cs:188-192). On TPU, a set lives in a *slot tensor*: ``[..., C]``
+arrays of int32 key fields plus payload fields, with a boolean ``valid``
+mask. Union is a data-parallel sort-based kernel:
+
+    concat -> lexicographic lax.sort on key fields -> fold adjacent
+    duplicates with a payload-combine -> stable compaction sort.
+
+Everything is static-shape and batches over arbitrary leading axes
+(replicas, keys), so XLA lays it onto the VPU; no per-element host loop.
+
+Invariants
+----------
+- Within one slot set, each valid slot has a unique key tuple (so after
+  concatenating two sets a key appears at most twice, making the
+  single-neighbor duplicate fold exact).
+- Key fields are int32 and < SENTINEL; invalid slots are canonicalized to
+  SENTINEL so they sort to the tail.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from janus_tpu.ops.lattice import SENTINEL
+
+Slots = Dict[str, jnp.ndarray]  # field -> [..., C]; must contain "valid"
+
+
+def make_slots(capacity: int, fields: Dict[str, jnp.dtype], batch: Tuple[int, ...] = ()) -> Slots:
+    """Allocate an empty slot set: all slots invalid, keys at SENTINEL."""
+    out: Slots = {"valid": jnp.zeros(batch + (capacity,), dtype=bool)}
+    for name, dt in fields.items():
+        fill = SENTINEL if jnp.issubdtype(dt, jnp.int32) else 0
+        out[name] = jnp.full(batch + (capacity,), fill, dtype=dt)
+    return out
+
+
+def _canon_keys(s: Slots, key_fields: Sequence[str]):
+    return [jnp.where(s["valid"], s[f], SENTINEL) for f in key_fields]
+
+
+def slot_union(
+    a: Slots,
+    b: Slots,
+    key_fields: Sequence[str],
+    combine: Callable[[Dict, Dict], Dict],
+    capacity: int | None = None,
+):
+    """Join two slot sets by key-union; duplicate keys fold payloads.
+
+    ``combine(p, q) -> dict`` merges the payload fields of two slots with
+    equal keys (e.g. OR for tombstones, lexicographic-max for timestamps).
+    Returns ``(out_slots, overflow)`` where ``overflow[...]`` counts kept
+    slots that did not fit in ``capacity`` (the moral replacement for the
+    reference's unbounded OR-Set growth — 196 MB messages per paper §6.2 —
+    is to size capacity and compact, not to grow).
+    """
+    nk = len(key_fields)
+    cap = capacity if capacity is not None else max(
+        a[key_fields[0]].shape[-1], b[key_fields[0]].shape[-1]
+    )
+    payload_fields = [f for f in a if f != "valid" and f not in key_fields]
+
+    cat_keys = [
+        jnp.concatenate([ka, kb], axis=-1)
+        for ka, kb in zip(_canon_keys(a, key_fields), _canon_keys(b, key_fields))
+    ]
+    cat_valid = jnp.concatenate([a["valid"], b["valid"]], axis=-1)
+    cat_pay = [jnp.concatenate([a[f], b[f]], axis=-1) for f in payload_fields]
+
+    sorted_ops = lax.sort(
+        tuple(cat_keys) + (cat_valid,) + tuple(cat_pay),
+        dimension=-1,
+        num_keys=nk,
+        is_stable=True,
+    )
+    skeys = sorted_ops[:nk]
+    svalid = sorted_ops[nk]
+    spay = {f: arr for f, arr in zip(payload_fields, sorted_ops[nk + 1:])}
+
+    # dup[i]: slot i carries the same key as slot i-1 (both valid).
+    same = svalid & jnp.roll(svalid, 1, axis=-1)
+    for k in skeys:
+        same = same & (k == jnp.roll(k, 1, axis=-1))
+    same = same.at[..., 0].set(False)
+    dup = same
+
+    # Fold the payload of a duplicate into its predecessor (the kept copy).
+    nxt_dup = jnp.concatenate([dup[..., 1:], jnp.zeros_like(dup[..., :1])], axis=-1)
+    nxt_pay = {f: jnp.roll(v, -1, axis=-1) for f, v in spay.items()}
+    folded = combine(spay, nxt_pay)
+    pay = {f: jnp.where(nxt_dup, folded[f], spay[f]) for f in payload_fields}
+    keep = svalid & ~dup
+
+    # Stable compaction: kept slots to the front, preserving key order.
+    rank = (~keep).astype(jnp.int32)
+    ops2 = (
+        (rank,)
+        + tuple(jnp.where(keep, k, SENTINEL) for k in skeys)
+        + (keep,)
+        + tuple(pay[f] for f in payload_fields)
+    )
+    sorted2 = lax.sort(ops2, dimension=-1, num_keys=1, is_stable=True)
+    out_keys = sorted2[1 : 1 + nk]
+    out_valid = sorted2[1 + nk]
+    out_pays = sorted2[2 + nk :]
+
+    def fit(arr, fill):
+        """Slice or SENTINEL-pad the trailing axis to exactly ``cap``."""
+        n = arr.shape[-1]
+        if n >= cap:
+            return arr[..., :cap]
+        pad = jnp.full(arr.shape[:-1] + (cap - n,), fill, dtype=arr.dtype)
+        return jnp.concatenate([arr, pad], axis=-1)
+
+    out: Slots = {"valid": fit(out_valid, False)}
+    for f, arr in zip(key_fields, out_keys):
+        out[f] = fit(arr, SENTINEL)
+    for f, arr in zip(payload_fields, out_pays):
+        out[f] = fit(arr, 0)
+    overflow = jnp.sum(keep, axis=-1) - jnp.sum(out["valid"], axis=-1)
+    return out, overflow
+
+
+# ---------------------------------------------------------------------------
+# Single-row helpers for op application (used under lax.scan when a batch of
+# client ops targets individual key rows). Rows are [C] slices.
+# ---------------------------------------------------------------------------
+
+def row_find(row: Slots, key_fields: Sequence[str], key_vals: Sequence[jnp.ndarray]):
+    """Locate a key in a row -> (found: bool, idx: int32). idx is arbitrary
+    when not found."""
+    hit = row["valid"]
+    for f, v in zip(key_fields, key_vals):
+        hit = hit & (row[f] == v)
+    return jnp.any(hit), jnp.argmax(hit).astype(jnp.int32)
+
+
+def row_first_free(row: Slots):
+    """First invalid slot -> (has_free: bool, idx: int32)."""
+    free = ~row["valid"]
+    return jnp.any(free), jnp.argmax(free).astype(jnp.int32)
+
+
+def row_insert(row: Slots, values: Dict[str, jnp.ndarray], enabled=True):
+    """Insert a slot into the first free position (drops silently when
+    full — callers track overflow via capacity headroom stats)."""
+    has_free, idx = row_first_free(row)
+    do = jnp.asarray(enabled) & has_free
+    out = dict(row)
+    for f, v in values.items():
+        out[f] = jnp.where(do, row[f].at[idx].set(v), row[f])
+    out["valid"] = jnp.where(do, row["valid"].at[idx].set(True), row["valid"])
+    return out
+
+
+def row_upsert(
+    row: Slots,
+    key_fields: Sequence[str],
+    key_vals: Sequence[jnp.ndarray],
+    values: Dict[str, jnp.ndarray],
+    combine_existing: Callable[[Dict, Dict], Dict],
+    enabled=True,
+):
+    """Insert a key or fold ``values`` into its existing slot.
+
+    ``combine_existing(old_payload, new_payload) -> payload`` decides the
+    update for an existing key (e.g. timestamp max for LWW adds).
+    """
+    found, idx = row_find(row, key_fields, key_vals)
+    en = jnp.asarray(enabled)
+
+    # Path 1: fold into existing slot.
+    old = {f: row[f][idx] for f in row if f != "valid" and f not in key_fields}
+    new = combine_existing(old, values)
+    updated = dict(row)
+    for f, v in new.items():
+        updated[f] = row[f].at[idx].set(v)
+
+    # Path 2: fresh insert.
+    ins_vals = dict(values)
+    for f, v in zip(key_fields, key_vals):
+        ins_vals[f] = v
+    inserted = row_insert(row, ins_vals, enabled=en)
+
+    out = {}
+    for f in row:
+        out[f] = jnp.where(
+            en & found, updated[f], jnp.where(en, inserted[f], row[f])
+        )
+    return out

@@ -1,0 +1,85 @@
+"""Lattice-algebra kernel tests (mirrors the pure-semantics layer of
+reference MergeSharp.Tests — no I/O, just join laws)."""
+import jax.numpy as jnp
+import numpy as np
+
+from janus_tpu.ops import (
+    clock_compare,
+    clock_dominates,
+    clock_leq,
+    join_max,
+    join_or,
+    ts_after,
+    ts_max,
+)
+from janus_tpu.ops.lattice import (
+    CLOCK_AFTER,
+    CLOCK_BEFORE,
+    CLOCK_CONCURRENT,
+    CLOCK_EQUAL,
+)
+
+
+def test_join_max_laws(rng):
+    a, b, c = (jnp.asarray(rng.integers(0, 100, (4, 7, 3)), jnp.int32) for _ in range(3))
+    # commutative, associative, idempotent
+    np.testing.assert_array_equal(join_max(a, b), join_max(b, a))
+    np.testing.assert_array_equal(
+        join_max(a, join_max(b, c)), join_max(join_max(a, b), c)
+    )
+    np.testing.assert_array_equal(join_max(a, a), a)
+
+
+def test_join_or_laws(rng):
+    a, b = (jnp.asarray(rng.integers(0, 2, (5, 9)), bool) for _ in range(2))
+    np.testing.assert_array_equal(join_or(a, b), join_or(b, a))
+    np.testing.assert_array_equal(join_or(a, a), a)
+
+
+def test_clock_compare_codes():
+    a = jnp.array([[1, 2, 3]], jnp.int32)
+    assert clock_compare(a, a)[0] == CLOCK_EQUAL
+    assert clock_compare(a, a + 1)[0] == CLOCK_BEFORE
+    assert clock_compare(a + 1, a)[0] == CLOCK_AFTER
+    b = jnp.array([[2, 1, 3]], jnp.int32)
+    assert clock_compare(a, b)[0] == CLOCK_CONCURRENT
+    assert not clock_dominates(a, a)[0]
+    assert clock_dominates(a + 1, a)[0]
+    assert clock_leq(a, a)[0]
+
+
+def test_clock_compare_batched(rng):
+    a = jnp.asarray(rng.integers(0, 4, (64, 8)), jnp.int32)
+    b = jnp.asarray(rng.integers(0, 4, (64, 8)), jnp.int32)
+    codes = np.asarray(clock_compare(a, b))
+    an, bn = np.asarray(a), np.asarray(b)
+    for i in range(64):
+        ale, ble = (an[i] <= bn[i]).all(), (bn[i] <= an[i]).all()
+        want = (
+            CLOCK_EQUAL if ale and ble else CLOCK_BEFORE if ale
+            else CLOCK_AFTER if ble else CLOCK_CONCURRENT
+        )
+        assert codes[i] == want
+
+
+def test_ts_pair_order_unsigned_low_word():
+    """Low words with bit 31 set must order as unsigned (regression)."""
+    a_hi, a_lo = jnp.int32(0), jnp.int32(-(2**31))  # counter 0x80000000
+    b_hi, b_lo = jnp.int32(0), jnp.int32(2**31 - 1)  # counter 0x7FFFFFFF
+    assert bool(ts_after(a_hi, a_lo, b_hi, b_lo))
+    assert not bool(ts_after(b_hi, b_lo, a_hi, a_lo))
+    mh, ml = ts_max(b_hi, b_lo, a_hi, a_lo)
+    assert (int(mh), int(ml)) == (0, -(2**31))
+
+
+def test_ts_pair_order(rng):
+    hi_a, lo_a, hi_b, lo_b = (
+        jnp.asarray(rng.integers(0, 3, (128,)), jnp.int32) for _ in range(4)
+    )
+    after = np.asarray(ts_after(hi_a, lo_a, hi_b, lo_b))
+    va = np.asarray(hi_a).astype(np.int64) * (1 << 32) + np.asarray(lo_a)
+    vb = np.asarray(hi_b).astype(np.int64) * (1 << 32) + np.asarray(lo_b)
+    np.testing.assert_array_equal(after, va >= vb)
+    mh, ml = ts_max(hi_a, lo_a, hi_b, lo_b)
+    vm = np.asarray(mh).astype(np.int64) * (1 << 32) + np.asarray(ml)
+    np.testing.assert_array_equal(vm, np.maximum(va, vb))

@@ -1,0 +1,164 @@
+"""Slot-set union kernel vs a Python dict reference model.
+
+Property tests for the join laws (commutativity, associativity,
+idempotence) that the reference asserts per-type in MergeSharp.Tests
+(ORSetTests.cs, LWWSetTests.cs) — here proven once at the kernel level.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from janus_tpu.ops import SENTINEL, make_slots, row_find, row_insert, row_upsert, slot_union
+
+
+def or_combine(p, q):
+    return {"removed": p["removed"] | q["removed"], "elem": p["elem"]}
+
+
+def random_slots(rng, cap, n):
+    """Random OR-Set-shaped slot set: key=(tag,), payload elem + removed."""
+    tags = rng.choice(10_000, size=n, replace=False)
+    tag = np.full(cap, int(np.iinfo(np.int32).max), np.int32)
+    elem = np.zeros(cap, np.int32)
+    removed = np.zeros(cap, bool)
+    valid = np.zeros(cap, bool)
+    tag[:n] = tags
+    elem[:n] = rng.integers(0, 50, n)
+    removed[:n] = rng.integers(0, 2, n)
+    valid[:n] = True
+    return {
+        "tag": jnp.asarray(tag),
+        "elem": jnp.asarray(elem),
+        "removed": jnp.asarray(removed),
+        "valid": jnp.asarray(valid),
+    }
+
+
+def to_dict(s):
+    """Slot set -> {tag: (elem, removed)} for comparison."""
+    out = {}
+    v = np.asarray(s["valid"])
+    for i in np.nonzero(v)[0]:
+        out[int(np.asarray(s["tag"])[i])] = (
+            int(np.asarray(s["elem"])[i]),
+            bool(np.asarray(s["removed"])[i]),
+        )
+    return out
+
+
+def dict_union(da, db):
+    out = dict(da)
+    for t, (e, r) in db.items():
+        if t in out:
+            out[t] = (out[t][0], out[t][1] or r)
+        else:
+            out[t] = (e, r)
+    return out
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_union_matches_reference_model(seed):
+    rng = np.random.default_rng(seed)
+    a = random_slots(rng, 32, rng.integers(0, 20))
+    b = random_slots(rng, 32, rng.integers(0, 20))
+    u, ovf = slot_union(a, b, ("tag",), or_combine, capacity=64)
+    assert int(ovf) == 0
+    assert to_dict(u) == dict_union(to_dict(a), to_dict(b))
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_union_laws(seed):
+    rng = np.random.default_rng(100 + seed)
+    a = random_slots(rng, 16, 10)
+    b = random_slots(rng, 16, 8)
+    c = random_slots(rng, 16, 5)
+    u_ab, _ = slot_union(a, b, ("tag",), or_combine, capacity=48)
+    u_ba, _ = slot_union(b, a, ("tag",), or_combine, capacity=48)
+    assert to_dict(u_ab) == to_dict(u_ba)  # commutative
+    u_aa, _ = slot_union(a, a, ("tag",), or_combine, capacity=48)
+    assert to_dict(u_aa) == to_dict(a)  # idempotent
+    l, _ = slot_union(u_ab, c, ("tag",), or_combine, capacity=48)
+    u_bc, _ = slot_union(b, c, ("tag",), or_combine, capacity=48)
+    r, _ = slot_union(a, u_bc, ("tag",), or_combine, capacity=48)
+    assert to_dict(l) == to_dict(r)  # associative
+
+
+def test_union_duplicate_tag_folds_tombstone():
+    """A tag removed on one side stays removed after union (tombstone OR)."""
+    a = {
+        "tag": jnp.array([5, SENTINEL], jnp.int32),
+        "elem": jnp.array([7, 0], jnp.int32),
+        "removed": jnp.array([False, False]),
+        "valid": jnp.array([True, False]),
+    }
+    b = {
+        "tag": jnp.array([5, 9], jnp.int32),
+        "elem": jnp.array([7, 8], jnp.int32),
+        "removed": jnp.array([True, False]),
+        "valid": jnp.array([True, True]),
+    }
+    u, _ = slot_union(a, b, ("tag",), or_combine, capacity=4)
+    assert to_dict(u) == {5: (7, True), 9: (8, False)}
+
+
+def test_union_pads_to_requested_capacity():
+    """capacity larger than the concatenated inputs must pad, not shrink."""
+    rng = np.random.default_rng(21)
+    a, b = random_slots(rng, 4, 3), random_slots(rng, 4, 2)
+    u, ovf = slot_union(a, b, ("tag",), or_combine, capacity=16)
+    assert all(u[f].shape[-1] == 16 for f in u)
+    assert int(ovf) == 0
+    assert to_dict(u) == dict_union(to_dict(a), to_dict(b))
+
+
+def test_union_overflow_reported():
+    rng = np.random.default_rng(7)
+    a = random_slots(rng, 16, 16)
+    b = random_slots(np.random.default_rng(8), 16, 16)
+    u, ovf = slot_union(a, b, ("tag",), or_combine, capacity=16)
+    kept = len(dict_union(to_dict(a), to_dict(b)))
+    assert int(ovf) == max(0, kept - 16)
+    assert int(np.asarray(u["valid"]).sum()) == min(16, kept)
+
+
+def test_union_batched_leading_axes():
+    """Union batches over leading (replica, key) axes without vmap."""
+    rng = np.random.default_rng(3)
+    rows_a = [random_slots(rng, 8, rng.integers(0, 6)) for _ in range(6)]
+    rows_b = [random_slots(rng, 8, rng.integers(0, 6)) for _ in range(6)]
+    stack = lambda rows: {
+        f: jnp.stack([r[f] for r in rows]).reshape(2, 3, 8) for f in rows[0]
+    }
+    u, _ = slot_union(stack(rows_a), stack(rows_b), ("tag",), or_combine, capacity=16)
+    flat = {f: np.asarray(u[f]).reshape(6, 16) for f in u}
+    for i in range(6):
+        got = to_dict({f: jnp.asarray(flat[f][i]) for f in u})
+        assert got == dict_union(to_dict(rows_a[i]), to_dict(rows_b[i]))
+
+
+def test_union_jits():
+    rng = np.random.default_rng(11)
+    a, b = random_slots(rng, 16, 9), random_slots(rng, 16, 4)
+    f = jax.jit(lambda x, y: slot_union(x, y, ("tag",), or_combine, capacity=32))
+    u, _ = f(a, b)
+    assert to_dict(u) == dict_union(to_dict(a), to_dict(b))
+
+
+def test_row_find_insert_upsert():
+    row = make_slots(4, {"elem": jnp.int32, "ts": jnp.int32})
+    found, _ = row_find(row, ("elem",), (jnp.int32(3),))
+    assert not bool(found)
+    row = row_insert(row, {"elem": jnp.int32(3), "ts": jnp.int32(10)})
+    found, idx = row_find(row, ("elem",), (jnp.int32(3),))
+    assert bool(found) and int(row["ts"][idx]) == 10
+    # upsert existing folds with max; new key inserts
+    comb = lambda old, new: {"ts": jnp.maximum(old["ts"], new["ts"])}
+    row = row_upsert(row, ("elem",), (jnp.int32(3),), {"ts": jnp.int32(7)}, comb)
+    row = row_upsert(row, ("elem",), (jnp.int32(5),), {"ts": jnp.int32(2)}, comb)
+    _, i3 = row_find(row, ("elem",), (jnp.int32(3),))
+    f5, i5 = row_find(row, ("elem",), (jnp.int32(5),))
+    assert int(row["ts"][i3]) == 10 and bool(f5) and int(row["ts"][i5]) == 2
+    # disabled upsert is a no-op
+    row2 = row_upsert(row, ("elem",), (jnp.int32(9),), {"ts": jnp.int32(1)}, comb, enabled=False)
+    np.testing.assert_array_equal(np.asarray(row2["valid"]), np.asarray(row["valid"]))
