@@ -1,0 +1,14 @@
+"""CRDT type models — the tensor equivalents of MergeSharp/MergeSharp/CRDTs/.
+
+Each module defines pure functions over a fixed-shape state pytree covering
+K keys at once, and registers a ``CRDTTypeSpec`` keyed by the reference's
+wire type codes. Importing this package registers every built-in type.
+"""
+
+from janus_tpu.models import base  # noqa: F401
+from janus_tpu.models import pncounter  # noqa: F401
+from janus_tpu.models import orset  # noqa: F401
+from janus_tpu.models import lwwset  # noqa: F401
+from janus_tpu.models import tpset  # noqa: F401
+from janus_tpu.models import mvregister  # noqa: F401
+from janus_tpu.models import graph  # noqa: F401

@@ -1,0 +1,181 @@
+"""2P2P Graph: two-phase vertex set + two-phase edge set.
+
+Reference: MergeSharp/MergeSharp/CRDTs/TPTPGraph.cs — composed of a
+``TPSet<Guid>`` of vertices and a ``TPSet<(Guid, Guid)>`` of edges;
+AddEdge requires both endpoints present, RemoveVertex requires no incident
+live edge (:78-133); LookupEdges filters edges with removed endpoints
+(:139-154); merge = the underlying TPSet unions.
+
+Tensor design: per key (= one graph per key slot), a vertex slot block
+(``v`` key field + ``removed`` bit) and an edge slot block (``src``/``dst``
+key fields + ``removed`` bit). Joins are two sorted slot-unions with
+tombstone-OR folds. The op-precondition checks (endpoint liveness, incident
+edges) are masked reductions over the blocks instead of hash probes.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+from jax import lax
+
+from janus_tpu.models import base
+from janus_tpu.ops import make_slots, row_upsert, slot_union
+
+OP_ADD_VERTEX = 1
+OP_REMOVE_VERTEX = 2
+OP_ADD_EDGE = 3
+OP_REMOVE_EDGE = 4
+
+State = Dict[str, jnp.ndarray]
+# {"v", "v_removed", "v_valid": [..., K, CV],
+#  "src", "dst", "e_removed", "e_valid": [..., K, CE]}
+
+_V_FIELDS = ("v", "v_removed", "v_valid")
+_E_FIELDS = ("src", "dst", "e_removed", "e_valid")
+
+
+def init(num_keys: int, v_capacity: int, e_capacity: int) -> State:
+    vs = make_slots(v_capacity, {"v": jnp.int32, "removed": jnp.bool_},
+                    batch=(num_keys,), key_fields=("v",))
+    es = make_slots(e_capacity, {"src": jnp.int32, "dst": jnp.int32,
+                                 "removed": jnp.bool_},
+                    batch=(num_keys,), key_fields=("src", "dst"))
+    return {
+        "v": vs["v"], "v_removed": vs["removed"], "v_valid": vs["valid"],
+        "src": es["src"], "dst": es["dst"],
+        "e_removed": es["removed"], "e_valid": es["valid"],
+    }
+
+
+def _vertex_live(row):
+    return row["v_valid"] & ~row["v_removed"]
+
+
+def _edge_live(row):
+    return row["e_valid"] & ~row["e_removed"]
+
+
+def apply_ops(state: State, ops: base.OpBatch) -> State:
+    """av: a0=v; rv: a0=v (requires live + no live incident edge);
+    ae: a0=src, a1=dst (requires both endpoints live);
+    re: a0=src, a1=dst (requires edge live)."""
+
+    def step(st, op):
+        k = op["key"]
+        row = {f: st[f][k] for f in st}
+        code = op["op"]
+
+        v_live = _vertex_live(row)
+        e_live = _edge_live(row)
+
+        def has_vertex(x):
+            return jnp.any(v_live & (row["v"] == x))
+
+        # -- add vertex ----------------------------------------------------
+        vrow = {"elem": row["v"], "removed": row["v_removed"], "valid": row["v_valid"]}
+        v_added = row_upsert(
+            vrow, ("elem",), (op["a0"],), {"removed": jnp.bool_(False)},
+            lambda old, new: {"removed": old["removed"]},
+            enabled=code == OP_ADD_VERTEX,
+        )
+
+        # -- remove vertex: live, and no live edge touches it --------------
+        incident = jnp.any(e_live & ((row["src"] == op["a0"]) | (row["dst"] == op["a0"])))
+        rv_ok = (code == OP_REMOVE_VERTEX) & has_vertex(op["a0"]) & ~incident
+        v_hit = row["v_valid"] & (row["v"] == op["a0"])
+        v_removed = v_added["removed"] | jnp.where(rv_ok, v_hit, False)
+
+        # -- add edge: both endpoints live ---------------------------------
+        ae_ok = (code == OP_ADD_EDGE) & has_vertex(op["a0"]) & has_vertex(op["a1"])
+        erow = {"src": row["src"], "dst": row["dst"],
+                "removed": row["e_removed"], "valid": row["e_valid"]}
+        e_added = row_upsert(
+            erow, ("src", "dst"), (op["a0"], op["a1"]), {"removed": jnp.bool_(False)},
+            lambda old, new: {"removed": old["removed"]},
+            enabled=ae_ok,
+        )
+
+        # -- remove edge: live ---------------------------------------------
+        e_hit = row["e_valid"] & (row["src"] == op["a0"]) & (row["dst"] == op["a1"])
+        re_ok = (code == OP_REMOVE_EDGE) & jnp.any(e_hit & ~row["e_removed"])
+        e_removed = e_added["removed"] | jnp.where(re_ok, e_hit, False)
+
+        out = {
+            "v": v_added["elem"], "v_removed": v_removed, "v_valid": v_added["valid"],
+            "src": e_added["src"], "dst": e_added["dst"],
+            "e_removed": e_removed, "e_valid": e_added["valid"],
+        }
+        st = {f: st[f].at[k].set(out[f]) for f in st}
+        return st, None
+
+    state, _ = lax.scan(step, state, ops)
+    return state
+
+
+def merge(a: State, b: State) -> State:
+    vcap = a["v"].shape[-1]
+    ecap = a["src"].shape[-1]
+    tomb = lambda p, q: {"removed": p["removed"] | q["removed"]}
+    va = {"elem": a["v"], "removed": a["v_removed"], "valid": a["v_valid"]}
+    vb = {"elem": b["v"], "removed": b["v_removed"], "valid": b["v_valid"]}
+    vu, _ = slot_union(va, vb, ("elem",), tomb, capacity=vcap)
+    ea = {"src": a["src"], "dst": a["dst"], "removed": a["e_removed"], "valid": a["e_valid"]}
+    eb = {"src": b["src"], "dst": b["dst"], "removed": b["e_removed"], "valid": b["e_valid"]}
+    eu, _ = slot_union(ea, eb, ("src", "dst"), tomb, capacity=ecap)
+    return {
+        "v": vu["elem"], "v_removed": vu["removed"], "v_valid": vu["valid"],
+        "src": eu["src"], "dst": eu["dst"],
+        "e_removed": eu["removed"], "e_valid": eu["valid"],
+    }
+
+
+def vertex_mask(state: State) -> jnp.ndarray:
+    return state["v_valid"] & ~state["v_removed"]
+
+
+def contains_vertex(state: State, key, v) -> jnp.ndarray:
+    return jnp.any(vertex_mask(state)[key] & (state["v"][key] == v), axis=-1)
+
+
+def edge_mask(state: State) -> jnp.ndarray:
+    """[..., K, CE] live edges with both endpoints live (the LookupEdges
+    dangling-edge filter as a batched membership test)."""
+    e_live = state["e_valid"] & ~state["e_removed"]
+    vm = vertex_mask(state)
+    vset = jnp.where(vm, state["v"], jnp.iinfo(jnp.int32).max)
+
+    def endpoint_live(x):
+        # [..., K, CE, CV] broadcast membership, reduced over CV
+        return jnp.any(x[..., :, None] == vset[..., None, :], axis=-1)
+
+    return e_live & endpoint_live(state["src"]) & endpoint_live(state["dst"])
+
+
+def contains_edge(state: State, key, src, dst) -> jnp.ndarray:
+    em = edge_mask(state)[key]
+    return jnp.any(
+        em & (state["src"][key] == src) & (state["dst"][key] == dst), axis=-1
+    )
+
+
+def vertex_count(state: State) -> jnp.ndarray:
+    return jnp.sum(vertex_mask(state), axis=-1)
+
+
+def edge_count(state: State) -> jnp.ndarray:
+    return jnp.sum(edge_mask(state), axis=-1)
+
+
+SPEC = base.register_type(
+    base.CRDTTypeSpec(
+        name="TPTPGraph",
+        type_code="graph",
+        init=init,
+        apply_ops=apply_ops,
+        merge=merge,
+        queries={"vertex_count": vertex_count, "edge_count": edge_count},
+        op_codes={"av": OP_ADD_VERTEX, "rv": OP_REMOVE_VERTEX,
+                  "ae": OP_ADD_EDGE, "re": OP_REMOVE_EDGE},
+    )
+)

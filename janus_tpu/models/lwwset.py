@@ -8,9 +8,11 @@ tie"), merge takes the per-element max of both maps (ApplySynchronizedUpdate).
 
 Tensor design: per key, E slots of (elem, add_hi/add_lo, rm_hi/rm_lo).
 Timestamps are 64-bit split into int32 (hi, lo) lanes with unsigned-low
-lexicographic order (ops.lattice.ts_after); "never stamped" is
-(TS_NONE_HI, 0) which orders below every real stamp. The join is the
-sorted slot-union with pairwise ts-max fold.
+lexicographic order (ops.lattice.ts_after); "never stamped" is (0, 0),
+which is both below every real stamp (callers must mint stamps > (0,0),
+e.g. epoch-based hi > 0 or lo >= 1) and the identity of the ts-max fold —
+so it coincides with the canonical zero fill of invalid slots. The join is
+the sorted slot-union with pairwise ts-max fold.
 """
 from __future__ import annotations
 
@@ -25,23 +27,18 @@ from janus_tpu.ops import make_slots, row_upsert, slot_union, ts_after, ts_max
 OP_ADD = 1
 OP_REMOVE = 2
 
-TS_NONE_HI = jnp.iinfo(jnp.int32).min  # sorts below any real stamp (hi >= 0)
-
 KEY_FIELDS = ("elem",)
 State = Dict[str, jnp.ndarray]
 
 
 def init(num_keys: int, capacity: int) -> State:
-    s = make_slots(
+    return make_slots(
         capacity,
         {"elem": jnp.int32, "add_hi": jnp.int32, "add_lo": jnp.int32,
          "rm_hi": jnp.int32, "rm_lo": jnp.int32},
+        batch=(num_keys,),
+        key_fields=KEY_FIELDS,
     )
-    for f in ("add_hi", "rm_hi"):
-        s[f] = jnp.full_like(s[f], TS_NONE_HI)
-    for f in ("add_lo", "rm_lo"):
-        s[f] = jnp.zeros_like(s[f])
-    return {f: jnp.broadcast_to(v, (num_keys,) + v.shape).copy() for f, v in s.items()}
 
 
 def _combine(p, q):
@@ -53,7 +50,8 @@ def _combine(p, q):
 
 def _slot_live(valid, add_hi, add_lo, rm_hi, rm_lo):
     """Contained: has an add stamp and add >= remove (add wins ties)."""
-    return valid & (add_hi != TS_NONE_HI) & ts_after(add_hi, add_lo, rm_hi, rm_lo)
+    has_add = (add_hi != 0) | (add_lo != 0)
+    return valid & has_add & ts_after(add_hi, add_lo, rm_hi, rm_lo)
 
 
 def apply_ops(state: State, ops: base.OpBatch) -> State:
@@ -81,11 +79,11 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
 
         added = upsert(
             {"add_hi": op["a1"], "add_lo": op["a2"],
-             "rm_hi": TS_NONE_HI, "rm_lo": jnp.int32(0)},
+             "rm_hi": jnp.int32(0), "rm_lo": jnp.int32(0)},
             is_add,
         )
         removed = upsert(
-            {"add_hi": TS_NONE_HI, "add_lo": jnp.int32(0),
+            {"add_hi": jnp.int32(0), "add_lo": jnp.int32(0),
              "rm_hi": op["a1"], "rm_lo": op["a2"]},
             is_rm & contained,
         )

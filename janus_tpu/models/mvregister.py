@@ -1,0 +1,155 @@
+"""Multi-Value Register with per-value vector clocks.
+
+Reference: MergeSharp/MergeSharp/CRDTs/MVRegister.cs — value list + vector
+clock ``Dictionary<Guid,int>``; Write bumps the writer's own clock entry and
+replaces the value list (:108-114); remote states are clock-compared
+(:168-206) and either overwrite, are dropped, or merge the value lists
+(:132-160).
+
+Design deviation (deliberate): the reference keeps ONE clock per register
+instance, which cannot distinguish "union of concurrent writes" from "a
+later write that observed them" — two replicas can reach equal clocks with
+different value lists and silently diverge. Here each *value* carries the
+vector clock of its write (the standard causal-MV-register formulation):
+
+    val   int32[..., K, V]      value id per slot (SENTINEL when invalid)
+    valid bool [..., K, V]
+    clock int32[..., K, V, W]   the writing op's vector clock
+
+Write = (pointwise max of all live clocks) + own-lane bump; the new value
+dominates everything it observed. Merge = slot union, then drop every
+value whose clock is strictly dominated by another live value's clock and
+dedupe identical (val, clock) entries; survivors are the pairwise-
+concurrent frontier. All checks are O(V^2 W) masked reductions, batched
+over keys — V (concurrency width) is small.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+from jax import lax
+
+from janus_tpu.models import base
+from janus_tpu.ops import SENTINEL
+
+OP_WRITE = 1
+
+State = Dict[str, jnp.ndarray]
+
+
+def init(num_keys: int, num_writers: int, capacity: int) -> State:
+    return {
+        "val": jnp.full((num_keys, capacity), SENTINEL, jnp.int32),
+        "valid": jnp.zeros((num_keys, capacity), bool),
+        "clock": jnp.zeros((num_keys, capacity, num_writers), jnp.int32),
+    }
+
+
+def apply_ops(state: State, ops: base.OpBatch) -> State:
+    """write: a0=value id, writer=writer lane — the write observes every
+    live value (clock = max over live slots, own lane + 1) and replaces the
+    value set with the single written value."""
+
+    def step(st, op):
+        k = op["key"]
+        en = op["op"] == OP_WRITE
+        vcap, w = st["clock"].shape[-2:]
+        live = st["valid"][k][:, None]  # [V, 1]
+        observed = jnp.max(jnp.where(live, st["clock"][k], 0), axis=0)  # [W]
+        new_clock = observed.at[op["writer"]].add(1)
+        clock_row = (
+            jnp.zeros((vcap, w), jnp.int32).at[0].set(new_clock)
+        )
+        val_row = jnp.full((vcap,), SENTINEL, jnp.int32).at[0].set(op["a0"])
+        valid_row = jnp.zeros((vcap,), bool).at[0].set(True)
+        st = {
+            "val": st["val"].at[k].set(jnp.where(en, val_row, st["val"][k])),
+            "valid": st["valid"].at[k].set(jnp.where(en, valid_row, st["valid"][k])),
+            "clock": st["clock"].at[k].set(jnp.where(en, clock_row, st["clock"][k])),
+        }
+        return st, None
+
+    state, _ = lax.scan(step, state, ops)
+    return state
+
+
+def merge(a: State, b: State) -> State:
+    out, _ = merge_with_stats(a, b)
+    return out
+
+
+def merge_with_stats(a: State, b: State):
+    """Causal frontier of the union; returns (state, overflow[..., K])."""
+    cap = a["val"].shape[-1]
+    num_writers = a["clock"].shape[-1]
+
+    val = jnp.concatenate([a["val"], b["val"]], axis=-1)          # [..., K, 2V]
+    valid = jnp.concatenate([a["valid"], b["valid"]], axis=-1)
+    clock = jnp.concatenate([a["clock"], b["clock"]], axis=-2)    # [..., K, 2V, W]
+
+    ci = clock[..., :, None, :]  # slot i
+    cj = clock[..., None, :, :]  # slot j
+    leq = jnp.all(ci <= cj, axis=-1)          # [..., K, 2V, 2V]
+    strictly = leq & jnp.any(ci < cj, axis=-1)
+    vj = valid[..., None, :]
+    dominated = jnp.any(strictly & vj, axis=-1)
+
+    # Dedupe exact (val, clock) twins: drop i if some j<i matches.
+    eq = leq & jnp.all(ci >= cj, axis=-1) & (val[..., :, None] == val[..., None, :])
+    n2 = val.shape[-1]
+    earlier = jnp.tril(jnp.ones((n2, n2), bool), k=-1)
+    dup = jnp.any(eq & vj & earlier, axis=-1)
+
+    keep = valid & ~dominated & ~dup
+
+    # Canonical compaction: kept slots to the front, ordered by
+    # (val, clock lanes) so equal frontiers are bit-equal.
+    rank = (~keep).astype(jnp.int32)
+    lane_keys = tuple(
+        jnp.where(keep, clock[..., i], 0) for i in range(num_writers)
+    )
+    ops = (rank, jnp.where(keep, val, SENTINEL)) + lane_keys + (keep,)
+    sorted_ops = lax.sort(ops, dimension=-1, num_keys=2 + num_writers, is_stable=True)
+    out_val = sorted_ops[1][..., :cap]
+    out_clock = jnp.stack(
+        [lane[..., :cap] for lane in sorted_ops[2 : 2 + num_writers]], axis=-1
+    )
+    out_valid = sorted_ops[-1][..., :cap]
+    overflow = jnp.sum(keep, axis=-1) - jnp.sum(out_valid, axis=-1)
+    return {"val": out_val, "valid": out_valid, "clock": out_clock}, overflow
+
+
+def values_mask(state: State) -> jnp.ndarray:
+    """[..., K, V] mask of current values (>1 live slot iff the key has
+    unresolved concurrent writes)."""
+    return state["valid"]
+
+
+def read(state: State, key):
+    """(vals[V], valid[V]) for one key — the multi-value read."""
+    return state["val"][key], state["valid"][key]
+
+
+def key_clock(state: State) -> jnp.ndarray:
+    """[..., K, W] pointwise max over live value clocks (the register-level
+    clock the reference stores explicitly)."""
+    live = state["valid"][..., None]
+    return jnp.max(jnp.where(live, state["clock"], 0), axis=-2)
+
+
+def num_values(state: State) -> jnp.ndarray:
+    return jnp.sum(state["valid"], axis=-1)
+
+
+SPEC = base.register_type(
+    base.CRDTTypeSpec(
+        name="MVRegister",
+        type_code="mvr",
+        init=init,
+        apply_ops=apply_ops,
+        merge=merge,
+        queries={"num_values": num_values},
+        op_codes={"w": OP_WRITE},
+    )
+)

@@ -42,12 +42,13 @@ State = Dict[str, jnp.ndarray]  # fields [..., K, C]; "valid" mask included
 
 
 def init(num_keys: int, capacity: int) -> State:
-    s = make_slots(
+    return make_slots(
         capacity,
         {"tag_rep": jnp.int32, "tag_ctr": jnp.int32, "elem": jnp.int32,
          "removed": jnp.bool_},
+        batch=(num_keys,),
+        key_fields=KEY_FIELDS,
     )
-    return {f: jnp.broadcast_to(v, (num_keys,) + v.shape).copy() for f, v in s.items()}
 
 
 def _combine(p, q):
@@ -136,7 +137,7 @@ def compact(state: State) -> State:
         rank,
         jnp.where(keep, state["tag_rep"], SENTINEL),
         jnp.where(keep, state["tag_ctr"], SENTINEL),
-        state["elem"],
+        jnp.where(keep, state["elem"], 0),
         state["removed"] & keep,
         keep,
     )

@@ -1,0 +1,103 @@
+"""Two-Phase Set (2P-Set) over element slots with sticky tombstones.
+
+Reference: MergeSharp/MergeSharp/CRDTs/2P-Set.cs — add set + remove set,
+``LookupAll = addSet \\ removeSet`` (:133-136), Remove only effective for
+currently-added elements (:113-126), no re-add after remove, merge = union
+of both sets (:188-192).
+
+Tensor design: one slot per element per key — ``elem`` key field and a
+``removed`` tombstone payload bit. "In the remove set" == tombstone set;
+since 2P-Set removal is permanent, a single sticky bit per element is the
+exact dense encoding of the two-set formulation. Join = sorted slot-union
+with tombstone-OR fold (same kernel as the OR-Set's).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+from jax import lax
+
+from janus_tpu.models import base
+from janus_tpu.ops import make_slots, row_upsert, slot_union
+
+OP_ADD = 1
+OP_REMOVE = 2
+
+KEY_FIELDS = ("elem",)
+State = Dict[str, jnp.ndarray]  # fields [..., K, C]
+
+
+def init(num_keys: int, capacity: int) -> State:
+    return make_slots(
+        capacity, {"elem": jnp.int32, "removed": jnp.bool_},
+        batch=(num_keys,), key_fields=KEY_FIELDS,
+    )
+
+
+def _combine(p, q):
+    """Duplicate elem fold: tombstone is sticky (remove-set union)."""
+    return {"removed": p["removed"] | q["removed"]}
+
+
+def apply_ops(state: State, ops: base.OpBatch) -> State:
+    """add: a0=elem — insert if absent (re-add of a removed elem is a no-op
+    on the lookup, as the tombstone stays). remove: a0=elem — tombstone
+    only when currently added (reference gates Remove on membership)."""
+
+    def step(st, op):
+        k = op["key"]
+        row = {f: st[f][k] for f in st}
+        en = op["op"] != base.OP_NOOP
+        is_add = en & (op["op"] == OP_ADD)
+        is_rm = en & (op["op"] == OP_REMOVE)
+
+        added = row_upsert(
+            row, KEY_FIELDS, (op["a0"],), {"removed": jnp.bool_(False)},
+            # existing slot: keep its tombstone (no resurrect)
+            lambda old, new: {"removed": old["removed"]},
+            enabled=is_add,
+        )
+        hit = row["valid"] & (row["elem"] == op["a0"])
+        present = jnp.any(hit & ~row["removed"])
+        tomb = jnp.where(is_rm & present, hit, False)
+        out = {f: added[f] for f in row}
+        out["removed"] = added["removed"] | tomb
+        st = {f: st[f].at[k].set(out[f]) for f in st}
+        return st, None
+
+    state, _ = lax.scan(step, state, ops)
+    return state
+
+
+def merge(a: State, b: State) -> State:
+    cap = a["elem"].shape[-1]
+    out, _ = slot_union(a, b, KEY_FIELDS, _combine, capacity=cap)
+    return out
+
+
+def lookup_mask(state: State) -> jnp.ndarray:
+    """[..., K, C] mask of contained slots (add-set minus remove-set)."""
+    return state["valid"] & ~state["removed"]
+
+
+def contains(state: State, key, elem) -> jnp.ndarray:
+    row = lookup_mask(state)[key]
+    return jnp.any(row & (state["elem"][key] == elem), axis=-1)
+
+
+def live_count(state: State) -> jnp.ndarray:
+    return jnp.sum(lookup_mask(state), axis=-1)
+
+
+SPEC = base.register_type(
+    base.CRDTTypeSpec(
+        name="TPSet",
+        type_code="tpset",
+        init=init,
+        apply_ops=apply_ops,
+        merge=merge,
+        queries={"contains": contains, "live_count": live_count},
+        op_codes={"a": OP_ADD, "r": OP_REMOVE},
+    )
+)

@@ -32,12 +32,25 @@ from janus_tpu.ops.lattice import SENTINEL
 Slots = Dict[str, jnp.ndarray]  # field -> [..., C]; must contain "valid"
 
 
-def make_slots(capacity: int, fields: Dict[str, jnp.dtype], batch: Tuple[int, ...] = ()) -> Slots:
-    """Allocate an empty slot set: all slots invalid, keys at SENTINEL."""
+def make_slots(
+    capacity: int,
+    fields: Dict[str, jnp.dtype],
+    batch: Tuple[int, ...] = (),
+    key_fields: Sequence[str] = (),
+) -> Slots:
+    """Allocate an empty slot set: all slots invalid.
+
+    Canonical-form contract (relied on by state-digest / convergence
+    comparisons): invalid slots hold SENTINEL in key fields and 0 in
+    payload fields — the same fill ``slot_union`` re-establishes on its
+    output. If ``key_fields`` is empty, every int32 field is treated as a
+    key (the pre-batching callers' behavior).
+    """
+    keys = set(key_fields)
     out: Slots = {"valid": jnp.zeros(batch + (capacity,), dtype=bool)}
     for name, dt in fields.items():
-        fill = SENTINEL if jnp.issubdtype(dt, jnp.int32) else 0
-        out[name] = jnp.full(batch + (capacity,), fill, dtype=dt)
+        is_key = name in keys if keys else jnp.issubdtype(dt, jnp.int32)
+        out[name] = jnp.full(batch + (capacity,), SENTINEL if is_key else 0, dtype=dt)
     return out
 
 
@@ -119,11 +132,16 @@ def slot_union(
         pad = jnp.full(arr.shape[:-1] + (cap - n,), fill, dtype=arr.dtype)
         return jnp.concatenate([arr, pad], axis=-1)
 
-    out: Slots = {"valid": fit(out_valid, False)}
+    # Canonicalize: invalid slots carry SENTINEL keys and zero payloads so
+    # that equal sets are bit-equal tensors (state digests / convergence
+    # asserts compare raw arrays).
+    valid = fit(out_valid, False)
+    out: Slots = {"valid": valid}
     for f, arr in zip(key_fields, out_keys):
-        out[f] = fit(arr, SENTINEL)
+        out[f] = jnp.where(valid, fit(arr, SENTINEL), SENTINEL)
     for f, arr in zip(payload_fields, out_pays):
-        out[f] = fit(arr, 0)
+        fitted = fit(arr, 0)
+        out[f] = jnp.where(valid, fitted, jnp.zeros_like(fitted))
     overflow = jnp.sum(keep, axis=-1) - jnp.sum(out["valid"], axis=-1)
     return out, overflow
 

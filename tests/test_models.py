@@ -1,0 +1,357 @@
+"""CRDT type-model semantics tests.
+
+The tensor analog of the reference's per-type suites
+(MergeSharp.Tests/ORSetTests.cs, LWWSetTests.cs, PNCounterTests.cs,
+2PSetTests.cs, MVRegisterTests.cs, TPTPGraphTests.cs): construct 2-3
+replica states, interleave ops, exchange state (merge = the reference's
+GetLastSynchronizedUpdate/ApplySynchronizedUpdate), assert convergence,
+add-wins / remove-permanence, idempotence.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from janus_tpu.models import base, graph, lwwset, mvregister, orset, pncounter, tpset
+
+
+def ops(**kw):
+    return base.make_op_batch(**kw)
+
+
+def assert_states_equal(a, b):
+    for f in a:
+        np.testing.assert_array_equal(np.asarray(a[f]), np.asarray(b[f]), err_msg=f)
+
+
+# ---------------------------------------------------------------------------
+# PNCounter
+# ---------------------------------------------------------------------------
+
+def test_pnc_inc_dec_value():
+    st = pncounter.init(num_keys=4, num_writers=3)
+    st = pncounter.apply_ops(
+        st,
+        ops(op=[pncounter.OP_INC, pncounter.OP_INC, pncounter.OP_DEC],
+            key=[0, 0, 0], a0=[5, 7, 2], writer=[0, 1, 0]),
+    )
+    vals = np.asarray(pncounter.value(st))
+    assert vals[0] == 10 and (vals[1:] == 0).all()
+
+
+def test_pnc_two_replica_convergence():
+    a = pncounter.init(4, 2)
+    b = pncounter.init(4, 2)
+    a = pncounter.apply_ops(a, ops(op=[1, 2], key=[1, 2], a0=[10, 3], writer=[0, 0]))
+    b = pncounter.apply_ops(b, ops(op=[1, 1], key=[1, 3], a0=[4, 9], writer=[1, 1]))
+    ab = pncounter.merge(a, b)
+    ba = pncounter.merge(b, a)
+    assert_states_equal(ab, ba)
+    vals = np.asarray(pncounter.value(ab))
+    assert vals[1] == 14 and vals[2] == -3 and vals[3] == 9
+    # idempotent re-merge
+    assert_states_equal(pncounter.merge(ab, a), ab)
+
+
+# ---------------------------------------------------------------------------
+# ORSet
+# ---------------------------------------------------------------------------
+
+def _orset_add(st, key, elem, tag_rep, tag_ctr):
+    return orset.apply_ops(
+        st, ops(op=[orset.OP_ADD], key=[key], a0=[elem], a1=[tag_rep], a2=[tag_ctr])
+    )
+
+
+def test_orset_add_remove_contains():
+    st = orset.init(num_keys=2, capacity=8)
+    st = _orset_add(st, 0, 42, 0, 1)
+    assert bool(orset.contains(st, 0, 42))
+    assert not bool(orset.contains(st, 1, 42))
+    st = orset.apply_ops(st, ops(op=[orset.OP_REMOVE], key=[0], a0=[42]))
+    assert not bool(orset.contains(st, 0, 42))
+
+
+def test_orset_add_wins_on_concurrent_add_remove():
+    """Reference ORSetTests: remove only tombstones *observed* tags, so a
+    concurrent add with a fresh tag survives the merge (add-wins)."""
+    a = orset.init(1, 8)
+    b = orset.init(1, 8)
+    a = _orset_add(a, 0, 7, 0, 1)      # replica 0 adds
+    b = orset.merge(b, a)              # replica 1 observes
+    b = orset.apply_ops(b, ops(op=[orset.OP_REMOVE], key=[0], a0=[7]))  # 1 removes
+    a = _orset_add(a, 0, 7, 0, 2)      # 0 concurrently re-adds (fresh tag)
+    m1 = orset.merge(a, b)
+    m2 = orset.merge(b, a)
+    assert_states_equal(m1, m2)
+    assert bool(orset.contains(m1, 0, 7))  # fresh tag not tombstoned
+
+
+def test_orset_remove_wins_over_observed_add():
+    a = orset.init(1, 8)
+    a = _orset_add(a, 0, 7, 0, 1)
+    b = orset.merge(orset.init(1, 8), a)
+    b = orset.apply_ops(b, ops(op=[orset.OP_REMOVE], key=[0], a0=[7]))
+    m = orset.merge(a, b)
+    assert not bool(orset.contains(m, 0, 7))
+
+
+def test_orset_clear_then_merge_no_resurrection():
+    a = orset.init(1, 8)
+    a = _orset_add(a, 0, 1, 0, 1)
+    a = _orset_add(a, 0, 2, 0, 2)
+    b = orset.merge(orset.init(1, 8), a)
+    b = orset.apply_ops(b, ops(op=[orset.OP_CLEAR], key=[0]))
+    m = orset.merge(a, b)
+    assert not bool(orset.contains(m, 0, 1))
+    assert not bool(orset.contains(m, 0, 2))
+    assert int(orset.live_count(m)[0]) == 0
+
+
+def test_orset_compact_reclaims_capacity():
+    st = orset.init(1, 4)
+    for i in range(4):
+        st = _orset_add(st, 0, i, 0, i + 1)
+    st = orset.apply_ops(st, ops(op=[orset.OP_CLEAR], key=[0]))
+    st = orset.compact(st)
+    assert int(np.asarray(st["valid"]).sum()) == 0  # all slots free again
+
+
+# ---------------------------------------------------------------------------
+# LWWSet
+# ---------------------------------------------------------------------------
+
+def _lww(st, op, key, elem, hi, lo):
+    return lwwset.apply_ops(st, ops(op=[op], key=[key], a0=[elem], a1=[hi], a2=[lo]))
+
+
+def test_lww_add_remove_readd():
+    st = lwwset.init(1, 8)
+    st = _lww(st, lwwset.OP_ADD, 0, 5, 0, 10)
+    assert bool(lwwset.contains(st, 0, 5))
+    st = _lww(st, lwwset.OP_REMOVE, 0, 5, 0, 20)
+    assert not bool(lwwset.contains(st, 0, 5))
+    st = _lww(st, lwwset.OP_ADD, 0, 5, 0, 30)
+    assert bool(lwwset.contains(st, 0, 5))
+
+
+def test_lww_add_wins_tie():
+    st = lwwset.init(1, 8)
+    st = _lww(st, lwwset.OP_ADD, 0, 5, 0, 10)
+    st = _lww(st, lwwset.OP_REMOVE, 0, 5, 0, 10)  # same stamp: add wins
+    assert bool(lwwset.contains(st, 0, 5))
+
+
+def test_lww_remove_requires_presence():
+    """Reference LWWSet.Remove only stamps when currently contained."""
+    st = lwwset.init(1, 8)
+    st = _lww(st, lwwset.OP_REMOVE, 0, 5, 0, 50)  # ignored: not present
+    st = _lww(st, lwwset.OP_ADD, 0, 5, 0, 10)     # older add still lands
+    assert bool(lwwset.contains(st, 0, 5))
+
+
+def test_lww_merge_convergence():
+    a = lwwset.init(2, 8)
+    b = lwwset.init(2, 8)
+    a = _lww(a, lwwset.OP_ADD, 0, 1, 0, 10)
+    b = _lww(b, lwwset.OP_ADD, 0, 1, 0, 5)
+    b = _lww(b, lwwset.OP_ADD, 1, 2, 0, 7)
+    m1, m2 = lwwset.merge(a, b), lwwset.merge(b, a)
+    assert_states_equal(m1, m2)
+    assert bool(lwwset.contains(m1, 0, 1))
+    assert bool(lwwset.contains(m1, 1, 2))
+    assert_states_equal(lwwset.merge(m1, m1), m1)
+
+
+# ---------------------------------------------------------------------------
+# TPSet
+# ---------------------------------------------------------------------------
+
+def _tp(st, op, key, elem):
+    return tpset.apply_ops(st, ops(op=[op], key=[key], a0=[elem]))
+
+
+def test_tpset_no_readd_after_remove():
+    st = tpset.init(1, 8)
+    st = _tp(st, tpset.OP_ADD, 0, 9)
+    assert bool(tpset.contains(st, 0, 9))
+    st = _tp(st, tpset.OP_REMOVE, 0, 9)
+    assert not bool(tpset.contains(st, 0, 9))
+    st = _tp(st, tpset.OP_ADD, 0, 9)  # 2P: re-add has no effect
+    assert not bool(tpset.contains(st, 0, 9))
+
+
+def test_tpset_remove_requires_membership():
+    st = tpset.init(1, 8)
+    st = _tp(st, tpset.OP_REMOVE, 0, 9)  # not present: no tombstone recorded
+    st = _tp(st, tpset.OP_ADD, 0, 9)
+    assert bool(tpset.contains(st, 0, 9))
+
+
+def test_tpset_merge_remove_propagates():
+    a = tpset.init(1, 8)
+    a = _tp(a, tpset.OP_ADD, 0, 9)
+    b = tpset.merge(tpset.init(1, 8), a)
+    b = _tp(b, tpset.OP_REMOVE, 0, 9)
+    m1, m2 = tpset.merge(a, b), tpset.merge(b, a)
+    assert_states_equal(m1, m2)
+    assert not bool(tpset.contains(m1, 0, 9))
+
+
+# ---------------------------------------------------------------------------
+# MVRegister
+# ---------------------------------------------------------------------------
+
+def _wr(st, key, val, writer):
+    return mvregister.apply_ops(
+        st, ops(op=[mvregister.OP_WRITE], key=[key], a0=[val], writer=[writer])
+    )
+
+
+def test_mvr_sequential_overwrite():
+    a = mvregister.init(1, num_writers=2, capacity=4)
+    a = _wr(a, 0, 100, 0)
+    b = mvregister.merge(mvregister.init(1, 2, 4), a)
+    b = _wr(b, 0, 200, 1)  # causally after a's write
+    m = mvregister.merge(a, b)
+    vals, valid = mvregister.read(m, 0)
+    live = set(np.asarray(vals)[np.asarray(valid)].tolist())
+    assert live == {200}  # b's clock dominates -> overwrite
+
+
+def test_mvr_concurrent_writes_merge():
+    a = mvregister.init(1, 2, 4)
+    b = mvregister.init(1, 2, 4)
+    a = _wr(a, 0, 100, 0)
+    b = _wr(b, 0, 200, 1)  # concurrent
+    m1 = mvregister.merge(a, b)
+    m2 = mvregister.merge(b, a)
+    for m in (m1, m2):
+        vals, valid = mvregister.read(m, 0)
+        live = set(np.asarray(vals)[np.asarray(valid)].tolist())
+        assert live == {100, 200}
+    assert int(mvregister.num_values(m1)[0]) == 2
+
+
+def test_mvr_local_dominates_keeps_local():
+    a = mvregister.init(1, 2, 4)
+    a = _wr(a, 0, 1, 0)
+    stale = mvregister.init(1, 2, 4)  # empty clock: a dominates
+    m = mvregister.merge(a, stale)
+    assert_states_equal(m, a)
+
+
+def test_mvr_no_divergence_on_equal_key_clocks():
+    """Regression: with a single register-level clock, a union of concurrent
+    writes and a later write that observed one of them can reach equal
+    clocks with different value sets and diverge. Per-value clocks must
+    converge both replicas to the dominating write."""
+    a = mvregister.init(1, 2, 4)
+    a = _wr(a, 0, 100, 0)                       # A: writer0 writes 100
+    c = mvregister.merge(mvregister.init(1, 2, 4), a)
+    d = mvregister.merge(mvregister.init(1, 2, 4), a)
+    cw = mvregister.init(1, 2, 4)
+    cw = _wr(cw, 0, 200, 1)                     # concurrent write of 200
+    c = mvregister.merge(c, cw)                 # C: {100, 200}
+    d = _wr(d, 0, 200, 1)                       # D: write observed 100 -> {200}
+    m1 = mvregister.merge(c, d)
+    m2 = mvregister.merge(d, c)
+    assert_states_equal(m1, m2)
+    vals, valid = mvregister.read(m1, 0)
+    live = set(np.asarray(vals)[np.asarray(valid)].tolist())
+    assert live == {200}  # D's write dominates both originals
+
+
+def test_mvr_write_collapses_concurrency():
+    a = mvregister.init(1, 2, 4)
+    b = mvregister.init(1, 2, 4)
+    a = _wr(a, 0, 100, 0)
+    b = _wr(b, 0, 200, 1)
+    m = mvregister.merge(a, b)          # 2 live values
+    m = _wr(m, 0, 300, 0)               # new write observes both
+    vals, valid = mvregister.read(m, 0)
+    live = set(np.asarray(vals)[np.asarray(valid)].tolist())
+    assert live == {300}
+    # and it dominates both originals
+    for other in (a, b):
+        mm = mvregister.merge(m, other)
+        v2, ok2 = mvregister.read(mm, 0)
+        assert set(np.asarray(v2)[np.asarray(ok2)].tolist()) == {300}
+
+
+# ---------------------------------------------------------------------------
+# TPTPGraph
+# ---------------------------------------------------------------------------
+
+def _g(st, op, key=0, a0=0, a1=0):
+    return graph.apply_ops(st, ops(op=[op], key=[key], a0=[a0], a1=[a1]))
+
+
+def test_graph_vertex_edge_lifecycle():
+    st = graph.init(1, v_capacity=8, e_capacity=8)
+    st = _g(st, graph.OP_ADD_VERTEX, a0=1)
+    st = _g(st, graph.OP_ADD_VERTEX, a0=2)
+    assert bool(graph.contains_vertex(st, 0, 1))
+    st = _g(st, graph.OP_ADD_EDGE, a0=1, a1=2)
+    assert bool(graph.contains_edge(st, 0, 1, 2))
+    # vertex with incident live edge cannot be removed
+    st = _g(st, graph.OP_REMOVE_VERTEX, a0=1)
+    assert bool(graph.contains_vertex(st, 0, 1))
+    st = _g(st, graph.OP_REMOVE_EDGE, a0=1, a1=2)
+    assert not bool(graph.contains_edge(st, 0, 1, 2))
+    st = _g(st, graph.OP_REMOVE_VERTEX, a0=1)
+    assert not bool(graph.contains_vertex(st, 0, 1))
+
+
+def test_graph_edge_requires_vertices():
+    st = graph.init(1, 8, 8)
+    st = _g(st, graph.OP_ADD_EDGE, a0=1, a1=2)  # neither endpoint exists
+    assert int(graph.edge_count(st)[0]) == 0
+
+
+def test_graph_dangling_edge_filtered_after_merge():
+    """Concurrent remove-vertex / add-edge: the edge survives in state but
+    LookupEdges filters it (reference TPTPGraph.LookupEdges :139-154)."""
+    a = graph.init(1, 8, 8)
+    a = _g(a, graph.OP_ADD_VERTEX, a0=1)
+    a = _g(a, graph.OP_ADD_VERTEX, a0=2)
+    b = graph.merge(graph.init(1, 8, 8), a)
+    a = _g(a, graph.OP_ADD_EDGE, a0=1, a1=2)       # concurrent add-edge
+    b = _g(b, graph.OP_REMOVE_VERTEX, a0=2)        # concurrent remove-vertex
+    m1, m2 = graph.merge(a, b), graph.merge(b, a)
+    assert_states_equal(m1, m2)
+    assert not bool(graph.contains_edge(m1, 0, 1, 2))
+    assert int(graph.edge_count(m1)[0]) == 0
+
+
+def test_graph_merge_idempotent():
+    a = graph.init(1, 8, 8)
+    a = _g(a, graph.OP_ADD_VERTEX, a0=1)
+    a = _g(a, graph.OP_ADD_VERTEX, a0=2)
+    a = _g(a, graph.OP_ADD_EDGE, a0=1, a1=2)
+    assert_states_equal(graph.merge(a, a), a)
+
+
+# ---------------------------------------------------------------------------
+# Canonical form: fresh and merged states are bit-equal (regression — the
+# init fill and the slot_union output fill must agree, or state digests
+# report spurious divergence).
+# ---------------------------------------------------------------------------
+
+def test_merge_is_bitwise_idempotent_from_init():
+    a = orset.init(1, 8)
+    a = _orset_add(a, 0, 7, 0, 1)
+    assert_states_equal(orset.merge(a, a), a)
+    l = lwwset.init(1, 8)
+    l = _lww(l, lwwset.OP_ADD, 0, 5, 0, 10)
+    assert_states_equal(lwwset.merge(l, l), l)
+    t = tpset.init(1, 8)
+    t = _tp(t, tpset.OP_ADD, 0, 5)
+    assert_states_equal(tpset.merge(t, t), t)
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+def test_registry_has_all_types():
+    codes = set(base.registered_types())
+    assert {"pnc", "orset", "lww", "tpset", "mvr", "graph"} <= codes
