@@ -36,17 +36,27 @@ def make_op_batch(
     op=None, key=None, a0=None, a1=None, a2=None, writer=None, batch: int | None = None
 ) -> OpBatch:
     """Build a dense op batch; missing fields are zero-filled."""
-    given = {"op": op, "key": key, "a0": a0, "a1": a1, "a2": a2, "writer": writer}
-    sizes = [len(v) for v in given.values() if v is not None]
-    n = batch if batch is not None else (sizes[0] if sizes else 0)
+    given = {
+        f: (None if v is None else jnp.asarray(v, jnp.int32))
+        for f, v in {"op": op, "key": key, "a0": a0, "a1": a1,
+                     "a2": a2, "writer": writer}.items()
+    }
+    present = [v for v in given.values() if v is not None]
+    if present:
+        shape = present[0].shape  # fills match the given fields' full shape
+    else:
+        shape = (batch if batch is not None else 0,)
     out = {}
     for f in OP_FIELDS:
         v = given[f]
-        out[f] = (
-            jnp.zeros((n,), jnp.int32)
-            if v is None
-            else jnp.asarray(v, jnp.int32)
-        )
+        arr = jnp.zeros(shape, jnp.int32) if v is None else v
+        if arr.shape != shape:
+            raise ValueError(f"op field {f!r} shape {arr.shape} != {shape}")
+        out[f] = arr
+    if batch is not None and present:
+        if len(shape) != 1:
+            raise ValueError("batch= only applies to 1-D op batches")
+        out = pad_op_batch(out, batch)  # no-op-pad up to the static size
     return out
 
 

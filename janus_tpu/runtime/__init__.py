@@ -1,0 +1,10 @@
+"""Replicated-store and SafeCRDT runtime (the L3a/L4 layers of SURVEY.md)."""
+
+from janus_tpu.runtime.store import (  # noqa: F401
+    Store,
+    apply_replica_ops,
+    converge,
+    gossip_step,
+    join_all,
+    replicated_init,
+)

@@ -1,0 +1,129 @@
+"""Replicated store: R emulated replicas of a typed key space as one
+tensor program.
+
+The ReplicationManager analog (reference MergeSharp/ReplicationManager.cs:
+GUID->instance table, outbound full-state sync on update at :347-357,
+inbound locked merge at :327-344) re-expressed tensor-first: a replica is
+not a process but a leading axis of the state pytree, updates are batched
+op records, and anti-entropy is a lattice-join over that axis. The
+single-host multi-replica form below is the analog of the reference's
+DummyConnectionManager in-memory broadcast tests
+(MergeSharp.Tests/DummyConnectionManager.cs:24-113) — and, sharded over a
+mesh (janus_tpu.parallel), of the real TCP gossip plane.
+
+Because every type's ``merge`` is a commutative/associative/idempotent
+join, "broadcast all deltas to everyone" collapses into a butterfly
+exchange: ceil(log2 R) rounds of merge-with-neighbor at doubling distance
+fully converge all R replicas, in-place, with static shapes.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from janus_tpu.models import base
+
+
+def replicated_init(spec: base.CRDTTypeSpec, num_replicas: int, **dims) -> Any:
+    """State pytree with a leading replica axis; all replicas start empty
+    (and therefore bit-identical)."""
+    one = spec.init(**dims)
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (num_replicas,) + x.shape).copy(), one
+    )
+
+
+def apply_replica_ops(spec: base.CRDTTypeSpec, state: Any, ops: base.OpBatch) -> Any:
+    """Apply per-replica op batches: each field of ``ops`` is [R, B]."""
+    return jax.vmap(spec.apply_ops)(state, ops)
+
+
+def gossip_step(spec: base.CRDTTypeSpec, state: Any, distance: int = 1) -> Any:
+    """One anti-entropy exchange: every replica merges the state of the
+    replica ``distance`` slots behind it (ring topology)."""
+    shifted = jax.tree.map(lambda x: jnp.roll(x, distance, axis=0), state)
+    return spec.merge(state, shifted)
+
+
+def join_all(spec: base.CRDTTypeSpec, state: Any) -> Any:
+    """Reduce the replica axis to a single global-join state [K, ...].
+
+    Overlapping halving tree-reduce: each round joins the first ceil(n/2)
+    rows with the last ceil(n/2) rows (the middle row lands in both when n
+    is odd — harmless, joins are idempotent). Touches ~2x the state total,
+    vs log2(R) full passes for a butterfly."""
+    n = jax.tree.leaves(state)[0].shape[0]
+    while n > 1:
+        half = (n + 1) // 2
+        left = jax.tree.map(lambda x: x[:half], state)
+        right = jax.tree.map(lambda x: x[n - half : n], state)
+        state = spec.merge(left, right)
+        n = half
+    return jax.tree.map(lambda x: x[0], state)
+
+
+def converge(spec: base.CRDTTypeSpec, state: Any) -> Any:
+    """Full anti-entropy: every replica ends at the global join, bit-equal
+    across the replica axis (canonical slot form). Implemented as
+    tree-reduce + broadcast — cheaper than running the gossip ring to
+    fixpoint when full convergence is the goal."""
+    num_replicas = jax.tree.leaves(state)[0].shape[0]
+    joined = join_all(spec, state)
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (num_replicas,) + x.shape), joined
+    )
+
+
+class Store:
+    """A host-side handle on R emulated replicas of several typed key
+    spaces, with jitted apply/converge per type.
+
+    The mutable-object-store role of the reference's ReplicationManager
+    (CreateCRDTInstance / GetCRDT / inbound-merge) shrinks to: a dict of
+    state pytrees plus three pure jitted functions.
+    """
+
+    def __init__(self, num_replicas: int, types: Dict[str, Dict[str, int]]):
+        self.num_replicas = num_replicas
+        self.specs = {tc: base.get_type(tc) for tc in types}
+        self.states = {
+            tc: replicated_init(self.specs[tc], num_replicas, **dims)
+            for tc, dims in types.items()
+        }
+        self._apply = {
+            tc: jax.jit(lambda s, o, _spec=self.specs[tc]: apply_replica_ops(_spec, s, o))
+            for tc in types
+        }
+        self._converge = {
+            tc: jax.jit(lambda s, _spec=self.specs[tc]: converge(_spec, s))
+            for tc in types
+        }
+        self._step = {
+            tc: jax.jit(
+                lambda s, d, _spec=self.specs[tc]: gossip_step(_spec, s, d),
+                static_argnums=1,
+            )
+            for tc in types
+        }
+
+    def apply(self, type_code: str, ops: base.OpBatch) -> None:
+        self.states[type_code] = self._apply[type_code](self.states[type_code], ops)
+
+    def gossip(self, type_code: str, distance: int = 1) -> None:
+        self.states[type_code] = self._step[type_code](self.states[type_code], distance)
+
+    def sync(self, type_code: str) -> None:
+        """Converge all replicas (the full anti-entropy round)."""
+        self.states[type_code] = self._converge[type_code](self.states[type_code])
+
+    def query(self, type_code: str, name: str, *args):
+        """Run a type query on every replica (args broadcast)."""
+        q = self.specs[type_code].queries[name]
+        in_axes = (0,) + (None,) * len(args)
+        return jax.vmap(q, in_axes=in_axes)(self.states[type_code], *args)
+
+    def rounds_to_converge(self) -> int:
+        return max(1, math.ceil(math.log2(max(2, self.num_replicas))))

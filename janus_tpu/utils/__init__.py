@@ -1,0 +1,3 @@
+"""Host-side utilities: id interning, config, perf counters."""
+
+from janus_tpu.utils.ids import Interner, TagMinter  # noqa: F401

@@ -1,0 +1,77 @@
+"""Host-side interning of strings / opaque values to dense int32 ids.
+
+The reference keys everything on GUIDs and arbitrary strings
+(ReplicationManager.cs GUID->instance table; ORSet element types are
+generic). Device tensors need dense int32 ids, and every id must stay
+below ops.lattice.SENTINEL (the invalid-slot marker). The interner is the
+host-side boundary where that mapping happens — the analog of the
+reference's Dictionary key lookups, done once per new value instead of on
+every op.
+"""
+from __future__ import annotations
+
+from typing import Dict, Hashable, Iterable, List
+
+import numpy as np
+
+from janus_tpu.ops.lattice import SENTINEL
+
+_MAX_ID = int(SENTINEL) - 1
+
+
+class Interner:
+    """Stable value -> int32 id table (sequential ids, 0-based)."""
+
+    def __init__(self) -> None:
+        self._ids: Dict[Hashable, int] = {}
+        self._values: List[Hashable] = []
+
+    def intern(self, value: Hashable) -> int:
+        got = self._ids.get(value)
+        if got is not None:
+            return got
+        nid = len(self._values)
+        if nid > _MAX_ID:
+            raise OverflowError("interner exhausted int32 id space")
+        self._ids[value] = nid
+        self._values.append(value)
+        return nid
+
+    def intern_all(self, values: Iterable[Hashable]) -> np.ndarray:
+        return np.asarray([self.intern(v) for v in values], np.int32)
+
+    def lookup(self, ident: int) -> Hashable:
+        return self._values[ident]
+
+    def __contains__(self, value: Hashable) -> bool:
+        return value in self._ids
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+
+class TagMinter:
+    """Mints unique (replica, counter) tag pairs for OR-Set adds — the
+    analog of ``Guid.NewGuid()`` per add (reference ORSet.cs:134-153),
+    but structured so tags are dense int32 pairs and per-replica ordered."""
+
+    def __init__(self, replica_id: int) -> None:
+        self.replica_id = int(replica_id)
+        self._next = 1  # 0 reserved so (0,0) never collides with zero fill
+
+    def mint(self) -> tuple[int, int]:
+        ctr = self._next
+        self._next += 1
+        if ctr > _MAX_ID:
+            raise OverflowError("tag counter exhausted")
+        return self.replica_id, ctr
+
+    def mint_many(self, n: int) -> np.ndarray:
+        """[n, 2] array of (replica, counter) tags."""
+        if self._next + n - 1 > _MAX_ID:
+            raise OverflowError("tag counter exhausted")
+        out = np.empty((n, 2), np.int32)
+        out[:, 0] = self.replica_id
+        out[:, 1] = np.arange(self._next, self._next + n, dtype=np.int32)
+        self._next += n
+        return out
