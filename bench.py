@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Headline benchmark: PN-Counter merge throughput over emulated replicas.
+
+Measures fully-propagated CRDT ops/sec: each counted op is applied at its
+origin replica AND joined into every other replica's state (one engine
+tick = apply + full butterfly anti-entropy). This is the work the
+reference does across its whole server fleet per client op — apply + N-1
+remote merges (ReplicationManager.cs:327-344, the 52.3%-CPU hot loop) —
+measured at the same "all replicas converged" point.
+
+Baseline: reference peak PN-Counter throughput ~260k ops/s on a 4-node
+cluster (paper §6.2 Fig 5, BASELINE.md). North star (BASELINE.json):
+>=1M merge-ops/s at 256 emulated replicas on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+# Benchmark geometry (env-overridable; defaults per BASELINE.json config 1
+# scaled to the 256-replica north star).
+R = int(os.environ.get("BENCH_REPLICAS", 256))
+K = int(os.environ.get("BENCH_KEYS", 1024))
+B = int(os.environ.get("BENCH_OPS_PER_REPLICA", 1024))
+TICKS = int(os.environ.get("BENCH_TICKS", 20))
+BASELINE_OPS_PER_SEC = 260_000.0
+
+
+def main() -> None:
+    import jax
+
+    from janus_tpu.models import base, pncounter
+    from janus_tpu.runtime.engine import jit_tick
+    from janus_tpu.runtime.store import replicated_init
+
+    rng = np.random.default_rng(0)
+    state = replicated_init(pncounter.SPEC, R, num_keys=K, num_writers=R)
+    tick = jit_tick(pncounter.SPEC)
+
+    def batch():
+        return base.make_op_batch(
+            op=rng.integers(1, 3, (R, B)),
+            key=rng.integers(0, K, (R, B)),
+            a0=rng.integers(1, 10, (R, B)),
+            writer=np.broadcast_to(np.arange(R, dtype=np.int32)[:, None], (R, B)),
+        )
+
+    ops = [batch() for _ in range(4)]  # rotate premade batches; host gen off-clock
+
+    # Scalar-readback sync: block_until_ready is a no-op on some remote
+    # backends (relay-tunneled PJRT); a host fetch of one element is a
+    # true execution barrier everywhere.
+    probe = jax.jit(lambda s: s["p"][0, 0, 0])
+
+    def sync(s):
+        return int(np.asarray(probe(s)))
+
+    # warmup / compile
+    state = tick(state, ops[0])
+    sync(state)
+
+    t0 = time.perf_counter()
+    for i in range(TICKS):
+        state = tick(state, ops[i % len(ops)])
+    sync(state)
+    dt = time.perf_counter() - t0
+
+    ops_per_sec = R * B * TICKS / dt
+    print(json.dumps({
+        "metric": "pnc_merge_ops_per_sec_256rep_converged",
+        "value": round(ops_per_sec, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(ops_per_sec / BASELINE_OPS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

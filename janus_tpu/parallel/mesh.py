@@ -1,0 +1,68 @@
+"""Device meshes and sharded replica execution.
+
+The reference scales by running one OS process per replica connected by
+full-mesh TCP (start_servers.py:115-133, Cluster.cs:38-59). Here the
+replica axis and the key axis of the state tensors are sharded over a
+``jax.sharding.Mesh``; XLA inserts the collectives that replace the wire:
+the butterfly gossip's ``jnp.roll`` over a sharded replica axis lowers to
+collective-permute over ICI, and key-sharded scatters stay local to their
+shard. No NCCL/MPI analog is hand-written — shardings + jit are the
+communication backend.
+
+Mesh axes:
+  replica — emulated-replica groups (data-parallel-like; gossip rides it)
+  key     — key-space shards (tensor-parallel-like; per-key ops local)
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from janus_tpu.models import base
+from janus_tpu.runtime.engine import make_tick
+
+
+def make_mesh(replica_shards: int, key_shards: int = 1, devices=None) -> Mesh:
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    need = replica_shards * key_shards
+    if devs.size < need:
+        raise ValueError(f"need {need} devices, have {devs.size}")
+    grid = devs[:need].reshape(replica_shards, key_shards)
+    return Mesh(grid, ("replica", "key"))
+
+
+def state_sharding(mesh: Mesh, state: Any):
+    """Shard [R, K, ...] state leaves over (replica, key); lower-rank
+    leaves shard over replica only."""
+
+    def spec_for(x):
+        if x.ndim >= 2:
+            return NamedSharding(mesh, P("replica", "key"))
+        return NamedSharding(mesh, P("replica"))
+
+    return jax.tree.map(spec_for, state)
+
+
+def ops_sharding(mesh: Mesh, ops: base.OpBatch):
+    """Op batches [R, B] shard over replica; every key shard sees all ops
+    for its replicas (ops route to key rows by scatter indices)."""
+    return {f: NamedSharding(mesh, P("replica", None)) for f in ops}
+
+
+def place(mesh: Mesh, state: Any, ops: base.OpBatch):
+    """Device-put state and ops with their canonical shardings."""
+    st = jax.device_put(state, state_sharding(mesh, state))
+    op = jax.device_put(ops, ops_sharding(mesh, ops))
+    return st, op
+
+
+def sharded_tick(spec: base.CRDTTypeSpec, mesh: Mesh, state: Any, ops: base.OpBatch):
+    """Jitted apply+converge with explicit in/out shardings over ``mesh``."""
+    return jax.jit(
+        make_tick(spec),
+        in_shardings=(state_sharding(mesh, state), ops_sharding(mesh, ops)),
+        out_shardings=state_sharding(mesh, state),
+    )

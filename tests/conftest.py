@@ -3,7 +3,10 @@
 Mirrors the reference's "multi-node without a cluster" test strategy
 (reference Tests/KVStoreTests.cs:16-80 runs 4 full server stacks in one
 process); here the analog is N virtual XLA CPU devices in one process.
-Must run before any jax import.
+
+The env vars must be set before jax import; the config.update handles
+environments where a site hook (e.g. a TPU-tunnel plugin) force-registers
+another platform ahead of CPU regardless of JAX_PLATFORMS.
 """
 import os
 
@@ -13,6 +16,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
