@@ -32,23 +32,18 @@ BASELINE_OPS_PER_SEC = 260_000.0
 def main() -> None:
     import jax
 
-    from janus_tpu.models import base, pncounter
+    from janus_tpu.models import pncounter
     from janus_tpu.runtime.engine import jit_tick
     from janus_tpu.runtime.store import replicated_init
+
+    from janus_tpu.bench.workloads import pnc_uniform
 
     rng = np.random.default_rng(0)
     state = replicated_init(pncounter.SPEC, R, num_keys=K, num_writers=R)
     tick = jit_tick(pncounter.SPEC)
 
-    def batch():
-        return base.make_op_batch(
-            op=rng.integers(1, 3, (R, B)),
-            key=rng.integers(0, K, (R, B)),
-            a0=rng.integers(1, 10, (R, B)),
-            writer=np.broadcast_to(np.arange(R, dtype=np.int32)[:, None], (R, B)),
-        )
-
-    ops = [batch() for _ in range(4)]  # rotate premade batches; host gen off-clock
+    # rotate premade batches; host gen off-clock
+    ops = [pnc_uniform(rng, R, K, B) for _ in range(4)]
 
     # Scalar-readback sync: block_until_ready is a no-op on some remote
     # backends (relay-tunneled PJRT); a host fetch of one element is a
@@ -70,7 +65,7 @@ def main() -> None:
 
     ops_per_sec = R * B * TICKS / dt
     print(json.dumps({
-        "metric": "pnc_merge_ops_per_sec_256rep_converged",
+        "metric": f"pnc_merge_ops_per_sec_{R}rep_converged",
         "value": round(ops_per_sec, 1),
         "unit": "ops/s",
         "vs_baseline": round(ops_per_sec / BASELINE_OPS_PER_SEC, 2),

@@ -1,0 +1,55 @@
+"""Workload generators for benchmarks and compile checks.
+
+The tensor analog of the reference's workload generators
+(BFT-CRDT-Client/WorkloadGenerator/BenchmarkWorkload.cs:10-162,
+PNCWorkload.cs, ORSetWorkload.cs): instead of N client threads rolling
+per-op dice, whole [R, B] op batches are drawn at once.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from janus_tpu.models import base, orset, pncounter
+
+
+def pnc_uniform(rng: np.random.Generator, num_replicas: int, num_keys: int,
+                batch: int) -> base.OpBatch:
+    """Uniform inc/dec mix over all keys; writer lane = replica id."""
+    shape = (num_replicas, batch)
+    return base.make_op_batch(
+        op=rng.integers(pncounter.OP_INC, pncounter.OP_DEC + 1, shape),
+        key=rng.integers(0, num_keys, shape),
+        a0=rng.integers(1, 10, shape),
+        writer=np.broadcast_to(
+            np.arange(num_replicas, dtype=np.int32)[:, None], shape
+        ),
+    )
+
+
+def orset_add_remove(rng: np.random.Generator, minters, num_keys: int,
+                     batch: int, num_elems: int = 64,
+                     add_ratio: float = 0.5) -> base.OpBatch:
+    """Add/remove mix with fresh per-replica tags for the adds (the
+    reference's ORSetWorkload a/r rotation)."""
+    num_replicas = len(minters)
+    shape = (num_replicas, batch)
+    is_add = rng.random(shape) < add_ratio
+    op = np.where(is_add, orset.OP_ADD, orset.OP_REMOVE).astype(np.int32)
+    tags = np.stack([m.mint_many(batch) for m in minters])  # [R, B, 2]
+    return base.make_op_batch(
+        op=op,
+        key=rng.integers(0, num_keys, shape),
+        a0=rng.integers(0, num_elems, shape),
+        a1=tags[..., 0],
+        a2=tags[..., 1],
+    )
+
+
+def zipf_keys(rng: np.random.Generator, num_keys: int, shape, theta: float = 0.99):
+    """Zipf-distributed key choice (the mixed-workload access pattern of
+    BASELINE.json config 3; the reference benchmarks use uniform/normal,
+    BankingBenchmarkRunner.cs:208-226)."""
+    ranks = np.arange(1, num_keys + 1, dtype=np.float64)
+    probs = 1.0 / ranks**theta
+    probs /= probs.sum()
+    return rng.choice(num_keys, size=shape, p=probs).astype(np.int32)

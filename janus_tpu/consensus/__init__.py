@@ -1,0 +1,21 @@
+"""DAG mempool (Narwhal-style) + Tusk wave commit as tensor programs."""
+
+from janus_tpu.consensus.dag import (  # noqa: F401
+    DagConfig,
+    advance_rounds,
+    create_blocks,
+    deliver_blocks,
+    deliver_certificates,
+    form_certificates,
+    init,
+    round_step,
+    sign_blocks,
+    structural_validity,
+)
+from janus_tpu.consensus.tusk import (  # noqa: F401
+    commit_view,
+    init_commit,
+    leaders,
+    order_key,
+    ordered_blocks,
+)

@@ -1,0 +1,189 @@
+"""Narwhal-style DAG mempool as a tensor program.
+
+Reference: BFT-CRDT/DAGConsensus/DAG.cs — per-node threads, dictionaries
+and locks: block creation/batching in AdvanceRoundLoop (:720-822), block
+validation + signature acks (ReceivedBlock :413-472), certificate
+formation at 2f+1 acks (ReceivedSignature :495-568), round advancement at
+2f+1 certificates (CheckCertificates :629-714), faulty-rate certificate
+withholding (:544-561).
+
+Tensor re-design: an emulated N-node cluster is ONE state pytree; a block
+is a (round, source) slot; every protocol rule is a masked reduction:
+
+    edges        bool[W, N, N]   block (r,s) references cert of (r-1,t)
+                                 (global truth: edge content is fixed at
+                                 creation and travels with the block)
+    block_exists bool[W, N]      block (r,s) has been created
+    block_seen   bool[N, W, N]   node v has received block (r,s)
+    acks         bool[W, N, N]   signer t has acked block (r,s)
+    cert_exists  bool[W, N]      2f+1 acks assembled by the creator
+    cert_seen    bool[N, W, N]   node v holds the certificate of (r,s)
+    node_round   int32[N]        current round per node
+
+Asynchrony — the reference's per-message hand-delivery in its tests
+(Tests/DAGTests.cs SimpleDAGMsgTestSender) — is expressed by *delivery
+masks*: each phase function takes an optional bool mask selecting which
+(recipient, round, source) messages land this call. Passing no mask gives
+the synchronous fast path (everything delivers), which is one XLA program
+per round. Equivocation is structurally impossible here (one slot per
+(round, source)); invalid-block pruning reduces to the structural
+validity mask. W is a static round window; quorum = 2f+1, f=(n-1)//3
+(DAG.cs:117).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+State = Dict[str, jnp.ndarray]
+
+
+@dataclasses.dataclass(frozen=True)
+class DagConfig:
+    num_nodes: int
+    num_rounds: int  # static window W
+
+    @property
+    def f(self) -> int:
+        return (self.num_nodes - 1) // 3
+
+    @property
+    def quorum(self) -> int:
+        return 2 * self.f + 1
+
+
+def init(cfg: DagConfig) -> State:
+    n, w = cfg.num_nodes, cfg.num_rounds
+    return {
+        "edges": jnp.zeros((w, n, n), bool),
+        "block_exists": jnp.zeros((w, n), bool),
+        "block_seen": jnp.zeros((n, w, n), bool),
+        "acks": jnp.zeros((w, n, n), bool),
+        "cert_exists": jnp.zeros((w, n), bool),
+        "cert_seen": jnp.zeros((n, w, n), bool),
+        "node_round": jnp.zeros((n,), jnp.int32),
+    }
+
+
+def _all_mask(cfg: DagConfig):
+    return jnp.ones((cfg.num_nodes, cfg.num_rounds, cfg.num_nodes), bool)
+
+
+def create_blocks(cfg: DagConfig, state: State, active: Optional[jnp.ndarray] = None) -> State:
+    """Each active node at round r creates its (r, v) block if it hasn't:
+    genesis blocks (r=0) reference nothing; later blocks reference every
+    certificate the creator holds for round r-1 (the reference includes
+    >=2f+1 prev certs — round advancement guarantees that many are held,
+    DAG.cs:774-812). The creator sees its own block and self-acks
+    (CreateBlock self-signature, DAG.cs:896-906)."""
+    n = cfg.num_nodes
+    vs = jnp.arange(n)
+    r = state["node_round"]
+    act = jnp.ones((n,), bool) if active is None else active
+    fresh = act & ~state["block_exists"][r, vs] & (r < cfg.num_rounds)
+
+    prev_r = jnp.maximum(r - 1, 0)
+    prev_certs = state["cert_seen"][vs, prev_r, :]  # [N, N]
+    new_edges = jnp.where((fresh & (r > 0))[:, None], prev_certs, False)
+
+    out = dict(state)
+    out["block_exists"] = state["block_exists"].at[r, vs].max(fresh)
+    out["edges"] = state["edges"].at[r, vs, :].max(new_edges)
+    out["block_seen"] = state["block_seen"].at[vs, r, vs].max(fresh)
+    out["acks"] = state["acks"].at[r, vs, vs].max(fresh)
+    return out
+
+
+def deliver_blocks(cfg: DagConfig, state: State, mask: Optional[jnp.ndarray] = None) -> State:
+    """Broadcast: node v receives block (r,s) where mask allows and the
+    block exists (mask axes: [recipient, round, source])."""
+    m = _all_mask(cfg) if mask is None else mask
+    out = dict(state)
+    out["block_seen"] = state["block_seen"] | (m & state["block_exists"][None])
+    return out
+
+
+def structural_validity(cfg: DagConfig, state: State) -> jnp.ndarray:
+    """bool[W, N]: genesis blocks are valid; later blocks need >=2f+1
+    embedded prev-certificate references (the receive-side check of
+    ReceivedBlock, DAG.cs:413-472 — certs travel inside the block, so the
+    check is structural)."""
+    refs = jnp.sum(state["edges"], axis=-1)  # [W, N]
+    rounds = jnp.arange(cfg.num_rounds)[:, None]
+    return (rounds == 0) | (refs >= cfg.quorum)
+
+
+def sign_blocks(cfg: DagConfig, state: State, mask: Optional[jnp.ndarray] = None) -> State:
+    """Every node acks each valid block it has seen; the signature is
+    delivered to the block's creator where mask allows (mask axes:
+    [signer, round, source])."""
+    m = _all_mask(cfg) if mask is None else mask
+    valid = structural_validity(cfg, state)  # [W, N]
+    sigs = state["block_seen"] & valid[None] & m  # [signer, W, N]
+    out = dict(state)
+    out["acks"] = state["acks"] | jnp.transpose(sigs, (1, 2, 0))
+    return out
+
+
+def form_certificates(cfg: DagConfig, state: State, withhold: Optional[jnp.ndarray] = None) -> State:
+    """A certificate exists once 2f+1 signatures are assembled
+    (ReceivedSignature quorum check, DAG.cs:520). ``withhold[W, N]``
+    suppresses certificate formation/broadcast by faulty creators — the
+    faultyRate Byzantine knob (DAG.cs:544-561). The creator immediately
+    holds its own certificate."""
+    n = cfg.num_nodes
+    counts = jnp.sum(state["acks"], axis=-1)  # [W, N]
+    formed = counts >= cfg.quorum
+    if withhold is not None:
+        formed = formed & ~withhold
+    out = dict(state)
+    out["cert_exists"] = state["cert_exists"] | formed
+    # own[v, r, s] = (v == s) & cert_exists[r, s] — creator holds its cert
+    own = out["cert_exists"][None, :, :] & (
+        jnp.arange(n)[:, None, None] == jnp.arange(n)[None, None, :]
+    )
+    out["cert_seen"] = state["cert_seen"] | own
+    return out
+
+
+def deliver_certificates(cfg: DagConfig, state: State, mask: Optional[jnp.ndarray] = None) -> State:
+    """Certificate broadcast (mask axes: [recipient, round, source])."""
+    m = _all_mask(cfg) if mask is None else mask
+    out = dict(state)
+    out["cert_seen"] = state["cert_seen"] | (m & state["cert_exists"][None])
+    return out
+
+
+def advance_rounds(cfg: DagConfig, state: State) -> State:
+    """A node advances past round r once it holds 2f+1 certificates for
+    round-r blocks (CheckCertificates round-advance signal,
+    DAG.cs:629-714)."""
+    n = cfg.num_nodes
+    vs = jnp.arange(n)
+    r = state["node_round"]
+    have = jnp.sum(state["cert_seen"][vs, r, :], axis=-1)
+    ready = (have >= cfg.quorum) & (r + 1 < cfg.num_rounds)
+    out = dict(state)
+    out["node_round"] = r + ready.astype(jnp.int32)
+    return out
+
+
+def round_step(cfg: DagConfig, state: State, active: Optional[jnp.ndarray] = None,
+               withhold: Optional[jnp.ndarray] = None) -> State:
+    """One synchronous protocol round: create -> broadcast -> sign ->
+    certify -> broadcast -> advance. With no masks this is the
+    full-delivery fast path (the whole cluster moves one round per call);
+    ``active``/``withhold`` model crashed and certificate-withholding
+    nodes. Crashed nodes neither create, sign, nor receive."""
+    act_mask = None
+    if active is not None:
+        act_mask = active[:, None, None] & _all_mask(cfg)
+    state = create_blocks(cfg, state, active)
+    state = deliver_blocks(cfg, state, act_mask)
+    state = sign_blocks(cfg, state, act_mask)
+    state = form_certificates(cfg, state, withhold)
+    state = deliver_certificates(cfg, state, act_mask)
+    state = advance_rounds(cfg, state)
+    return state
