@@ -35,7 +35,13 @@ def orset_add_remove(rng: np.random.Generator, minters, num_keys: int,
     shape = (num_replicas, batch)
     is_add = rng.random(shape) < add_ratio
     op = np.where(is_add, orset.OP_ADD, orset.OP_REMOVE).astype(np.int32)
-    tags = np.stack([m.mint_many(batch) for m in minters])  # [R, B, 2]
+    # fresh tags only for the add lanes (removes ignore a1/a2; minting for
+    # them would burn counter space for nothing)
+    tags = np.zeros(shape + (2,), np.int32)
+    for i, m in enumerate(minters):
+        lanes = np.nonzero(is_add[i])[0]
+        if lanes.size:
+            tags[i, lanes] = m.mint_many(lanes.size)
     return base.make_op_batch(
         op=op,
         key=rng.integers(0, num_keys, shape),

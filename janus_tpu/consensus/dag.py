@@ -178,12 +178,20 @@ def round_step(cfg: DagConfig, state: State, active: Optional[jnp.ndarray] = Non
     ``active``/``withhold`` model crashed and certificate-withholding
     nodes. Crashed nodes neither create, sign, nor receive."""
     act_mask = None
+    wh = withhold
     if active is not None:
         act_mask = active[:, None, None] & _all_mask(cfg)
+        # a crashed creator cannot aggregate acks into a certificate
+        # (signatures return to the creator, ReceivedSignature
+        # DAG.cs:495-568) — treat it as withholding while down
+        crash_wh = jnp.broadcast_to(
+            ~active[None, :], (cfg.num_rounds, cfg.num_nodes)
+        )
+        wh = crash_wh if wh is None else (wh | crash_wh)
     state = create_blocks(cfg, state, active)
     state = deliver_blocks(cfg, state, act_mask)
     state = sign_blocks(cfg, state, act_mask)
-    state = form_certificates(cfg, state, withhold)
+    state = form_certificates(cfg, state, wh)
     state = deliver_certificates(cfg, state, act_mask)
     state = advance_rounds(cfg, state)
     return state

@@ -91,6 +91,7 @@ def commit_view(
     cstate: State,
     node: int | None = None,
     seed: int = 0,
+    lookback: int | None = None,
 ) -> State:
     """Run the Tusk commit rule for every node's view (or one node).
 
@@ -135,11 +136,16 @@ def commit_view(
             # leaders; one is chained in iff reachable from the current
             # chain head (which then moves to it); an already-committed
             # leader ends the walk (Consensus.cs:97-109).
+            # lookback bounds the back-chain window (and therefore trace
+            # size): leaders skipped for more than `lookback` waves are
+            # abandoned, the tensor analog of the reference's GC of old
+            # committed rounds (DAG.cs:946-965)
+            lo = 0 if lookback is None else max(0, wv - lookback)
             head_reach = reach
             chain_alive = anchor_ok
-            sub_oks: list = [None] * wv
-            sub_closures: list = [None] * wv
-            for wp in range(wv - 1, -1, -1):
+            sub_oks: dict = {}
+            sub_closures: dict = {}
+            for wp in range(wv - 1, lo - 1, -1):
                 lp = int(ldrs[wp])
                 closure_p = _reach_from(cfg, dag_state["edges"], seen_v, 2 * wp, lp)
                 already = com_v[2 * wp, lp]
@@ -151,7 +157,7 @@ def commit_view(
 
             # Commit oldest-first: each chained leader anchors its own
             # not-yet-committed closure with its own sequence number.
-            for wp in range(0, wv):
+            for wp in range(lo, wv):
                 sub_ok = sub_oks[wp]
                 sub_new = sub_closures[wp] & ~com_v
                 com_v = jnp.where(sub_ok, com_v | sub_new, com_v)

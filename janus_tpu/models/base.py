@@ -83,6 +83,16 @@ class CRDTTypeSpec:
     merge: Callable[[Any, Any], Any]
     queries: Dict[str, Callable]
     op_codes: Dict[str, int]  # wire opCode letter -> op id (CmdParser.cs:12-16)
+    # Effect capture for replicated replay: extra per-op payload fields
+    # (name -> dim-name resolved against the type's init dims, giving the
+    # trailing width) filled by ``prepare_ops(origin_state, ops) -> ops``
+    # at submit time. Needed by types whose ops read their observed state
+    # (OR-Set remove tombstones *observed* tags): capturing the
+    # observation makes replay commutative across delivery groupings,
+    # the tensor analog of the reference shipping full state snapshots
+    # instead of operations (ReplicationManager.cs:347-357).
+    op_extras: Dict[str, str] = dataclasses.field(default_factory=dict)
+    prepare_ops: Callable[[Any, OpBatch], OpBatch] | None = None
 
 
 _REGISTRY: Dict[str, CRDTTypeSpec] = {}

@@ -56,15 +56,46 @@ def _combine(p, q):
     return {"removed": p["removed"] | q["removed"], "elem": p["elem"]}
 
 
+def prepare_ops(state: State, ops: base.OpBatch) -> base.OpBatch:
+    """Effect capture at the origin: remove/clear ops record the
+    per-minting-replica tag-counter frontier they observe, so replicated
+    replay tombstones exactly the observed tags no matter how delivery
+    batches ops (the reference gets this for free by shipping state
+    snapshots; op replay without capture is not commutative).
+
+    frontier[b, p] = highest tag_ctr minted by replica p among the
+    observed (valid) tags the op covers — elem-matched for remove, all
+    tags for clear; 0 = nothing observed (real counters start at 1).
+    """
+    num_writers = ops["frontier"].shape[-1]
+    rows_valid = state["valid"][ops["key"]]    # [B, C]
+    rows_elem = state["elem"][ops["key"]]
+    rows_rep = state["tag_rep"][ops["key"]]
+    rows_ctr = state["tag_ctr"][ops["key"]]
+    is_rm = ops["op"] == OP_REMOVE
+    is_cl = ops["op"] == OP_CLEAR
+    sel = rows_valid & jnp.where(is_rm[:, None], rows_elem == ops["a0"][:, None], True)
+    sel = sel & (is_rm | is_cl)[:, None]
+    onehot = rows_rep[..., None] == jnp.arange(num_writers)[None, None, :]
+    frontier = jnp.max(
+        jnp.where(sel[..., None] & onehot, rows_ctr[..., None], 0), axis=1
+    ).astype(jnp.int32)
+    return {**ops, "frontier": frontier}
+
+
 def apply_ops(state: State, ops: base.OpBatch) -> State:
     """Apply add/remove/clear ops sequentially (lax.scan) — adds need a
     fresh slot each, so within-batch ordering matters, exactly like the
     reference's per-object lock serialization (ORSetCommand.cs).
 
     add:    a0=elem, a1=tag_rep, a2=tag_ctr (host mints unique tags)
-    remove: a0=elem  (tombstones the currently observed tags of elem)
-    clear:  tombstones every observed tag
+    remove: a0=elem  (tombstones observed tags of elem; with a prepared
+            ``frontier`` field, "observed" is the captured frontier —
+            tags (p, c) with c <= frontier[p] — otherwise whatever is
+            locally present at apply time)
+    clear:  tombstones every observed tag (same frontier rule)
     """
+    has_frontier = "frontier" in ops
 
     def step(st, op):
         k = op["key"]
@@ -77,8 +108,12 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
              "removed": jnp.bool_(False)},
             enabled=en & (op["op"] == OP_ADD),
         )
-        rm_mask = row["valid"] & (row["elem"] == op["a0"])
-        clear_mask = row["valid"]
+        if has_frontier:
+            within = row["tag_ctr"] <= op["frontier"][row["tag_rep"]]
+        else:
+            within = jnp.ones_like(row["valid"])
+        rm_mask = row["valid"] & (row["elem"] == op["a0"]) & within
+        clear_mask = row["valid"] & within
         tomb = jnp.where(
             en & (op["op"] == OP_REMOVE),
             rm_mask,
@@ -159,5 +194,7 @@ SPEC = base.register_type(
         queries={"contains": contains, "live_count": live_count},
         # wire opCodes: a=add, r=remove, c=clear (ORSetCommand.cs:13-87)
         op_codes={"a": OP_ADD, "r": OP_REMOVE, "c": OP_CLEAR},
+        op_extras={"frontier": "num_nodes"},
+        prepare_ops=prepare_ops,
     )
 )

@@ -8,3 +8,6 @@ from janus_tpu.runtime.store import (  # noqa: F401
     join_all,
     replicated_init,
 )
+from janus_tpu.runtime.engine import jit_tick, make_local_tick, make_tick  # noqa: F401
+from janus_tpu.runtime.safecrdt import SafeKV, apply_masked  # noqa: F401
+from janus_tpu.runtime.keyspace import KeySpace, TypedKeySpace  # noqa: F401

@@ -1,0 +1,81 @@
+"""Key-space management: named keys -> dense key slots per type.
+
+Reference: BFT-CRDT/CRDTManagers/KeySpaceManager.cs — the key->GUID
+namespace is itself a replicated TPSet<string> with a fixed uid; the
+primary creates it, every replica observes creates and materializes
+SafeCRDTs for remotely-created keys (:55-113, :151-177).
+
+Tensor re-design: key *state* is pre-allocated (a type's whole key space
+is one fixed-shape tensor), so "creating" a key only means assigning it a
+slot index. Slot assignment must be identical on every node; here it is
+host-side and deterministic (interning order at the ingest boundary —
+the moral equivalent of the reference's primary-creates bootstrap).
+Create commands still flow through the DAG inside regular op batches, so
+remote views learn keys in consensus order; with a single logical ingest
+layer (the emulated-cluster setup) the host interner and the committed
+create order agree by construction. True multi-ingest deployments order
+creates by their commit position (commit_seq, round, source) — the same
+rule the reference gets from replicating its keyspace TPSet through the
+DAG.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+from janus_tpu.utils.ids import Interner
+
+
+@dataclasses.dataclass
+class TypedKeySpace:
+    """Slot table for one replicated type (capacity = num_keys)."""
+
+    type_code: str
+    capacity: int
+    keys: Interner = dataclasses.field(default_factory=Interner)
+
+    def create(self, key: str) -> int:
+        """Assign (or return) the key's slot — KeySpaceManager.
+        CreateNewKVPair analog (:121-136). Raises when the key space is
+        full (the reference grows unboundedly; fixed capacity is the
+        TPU-side contract, sized at init)."""
+        if key not in self.keys and len(self.keys) >= self.capacity:
+            raise KeyError(
+                f"key space for {self.type_code!r} full ({self.capacity})"
+            )
+        return self.keys.intern(key)
+
+    def lookup(self, key: str) -> Optional[int]:
+        """Slot for an existing key, or None (GetKVPair analog)."""
+        return self.keys.get(key)
+
+    def name_of(self, slot: int) -> str:
+        return self.keys.lookup(slot)
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+
+class KeySpace:
+    """All typed key spaces of one cluster (the KeySpaceManager +
+    SafeCRDTManager.TypeMap registry seam)."""
+
+    def __init__(self, capacities: Dict[str, int]):
+        self.spaces = {
+            tc: TypedKeySpace(tc, cap) for tc, cap in capacities.items()
+        }
+
+    def create(self, type_code: str, key: str) -> int:
+        return self.spaces[type_code].create(key)
+
+    def lookup(self, type_code: str, key: str) -> Optional[int]:
+        return self.spaces[type_code].lookup(key)
+
+    def resolve(self, type_code: str, key: str) -> Tuple[int, bool]:
+        """(slot, existed). Missing keys are created — the reference
+        returns an error for ops on unknown keys; batched tensor ingest
+        prefers create-on-first-use with the `existed` bit for callers
+        that must reject."""
+        sp = self.spaces[type_code]
+        existed = key in sp.keys
+        return sp.create(key), existed

@@ -1,0 +1,272 @@
+"""SafeCRDT dual-state runtime: prospective + stable key spaces driven by
+the DAG.
+
+Reference: BFT-CRDT/SafeCRDTs/SafeCRDT.cs (:19-84) — every kv-pair holds a
+*prospective* CRDT (updated immediately, converges via certified DAG
+blocks) and a *stable* CRDT (updated only in Tusk's total order);
+SafeCRDTManager (:61-198) batches client updates into UpdateMessages for
+the DAG, applies consensus output to stable states, and tracks safe
+updates for deferred client acks; DAGConnectionManager (:40-50) replays
+certified blocks' updates into the replication manager.
+
+Tensor re-design: one emulated N-node cluster in one pytree.
+
+    prospective  type-state with leading node axis [N, K, ...]
+    stable       same shape
+    ops_buffer   [W, N, B] op records: the op batch carried by block (r,s)
+                 (the UpdateMessage payload; content travels with the
+                 block, so it is global truth like ``edges``)
+    prosp_applied / stable_applied  bool[N, W, N]: which blocks each node
+                 has folded into which state
+
+Per tick: buffered ops ride the node's next block (round_step); blocks
+newly *certified* in a node's view apply to its prospective state (gated
+by causal closure — a block applies only after its whole referenced
+history, the CheckCertificates predecessor-completeness rule); blocks
+newly *committed* (commit_view) apply to its stable state. Replicated
+replay is made order-insensitive by *effect capture*: ops whose meaning
+depends on observed state (OR-Set remove/clear) record what they observed
+at the origin (spec.prepare_ops / op_extras), the tensor analog of the
+reference shipping state snapshots rather than operations. The Tusk
+order key remains available for order-sensitive consumers (safe-update
+acks, invariant checks).
+
+The local (origin) replica applies its own ops to its own prospective
+immediately at submit — the reference's "plain update" fast path that
+answers the client before any network round (SafeCRDT.Update :39-62).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from janus_tpu.consensus import dag as dagmod
+from janus_tpu.consensus import tusk
+from janus_tpu.models import base
+
+
+def _flatten_buffer(ops_buffer: base.OpBatch) -> base.OpBatch:
+    """[W, N, B, *extra] op fields -> [W*N*B, *extra] (flat order is
+    round-major, so a single scan applies blocks in causal round order)."""
+    return {
+        f: v.reshape((-1,) + v.shape[3:]) for f, v in ops_buffer.items()
+    }
+
+
+def apply_masked(spec, state, ops_buffer: base.OpBatch, mask: jnp.ndarray):
+    """Fold the op batches of masked blocks into each node's state.
+
+    state: [N_view, K, ...]; ops_buffer: [W, N, B, *extra];
+    mask: [N_view, W, N]. Ops of unselected blocks neutralize to no-ops.
+    """
+    flat = _flatten_buffer(ops_buffer)
+
+    def one_view(st, m):
+        enable = jnp.broadcast_to(
+            m[:, :, None], ops_buffer["op"].shape
+        ).reshape(-1)
+        ops = dict(flat)
+        ops["op"] = jnp.where(enable, flat["op"], base.OP_NOOP)
+        return spec.apply_ops(st, ops)
+
+    return jax.vmap(one_view)(state, mask)
+
+
+class SafeKV:
+    """An emulated N-node Reliable-CRDT cluster for one replicated type.
+
+    The composition root (the JanusService.Init analog, JanusService.cs:
+    36-72) wiring DAG + Tusk + dual state + safe-update tracking into one
+    steppable object. All device work happens in two jitted programs:
+    ``submit`` (local apply + buffer) and ``tick`` (round + certify-apply
+    + commit-apply).
+    """
+
+    def __init__(self, cfg: dagmod.DagConfig, spec, ops_per_block: int,
+                 seed: int = 0, **dims):
+        self.cfg = cfg
+        self.spec = spec
+        self.B = ops_per_block
+        self.seed = seed
+        n, w = cfg.num_nodes, cfg.num_rounds
+
+        one = spec.init(**dims)
+        rep = lambda x: jnp.broadcast_to(x, (n,) + x.shape).copy()
+        self.prospective = jax.tree.map(rep, one)
+        self.stable = jax.tree.map(rep, one)
+        self.dag = dagmod.init(cfg)
+        self.commit = tusk.init_commit(cfg)
+        # op payload per block slot; effect-capture extras resolve their
+        # width against the type dims (+ the cluster size)
+        dim_env = {**dims, "num_nodes": n}
+        self.extra_widths = {
+            name: int(dim_env[dim]) for name, dim in spec.op_extras.items()
+        }
+        self.ops_buffer = {
+            f: jnp.zeros((w, n, self.B), jnp.int32) for f in base.OP_FIELDS
+        }
+        for name, width in self.extra_widths.items():
+            self.ops_buffer[name] = jnp.zeros((w, n, self.B, width), jnp.int32)
+        self.buffer_filled = jnp.zeros((w, n), bool)
+        self.prosp_applied = jnp.zeros((n, w, n), bool)
+        self.stable_applied = jnp.zeros((n, w, n), bool)
+        # host-side bookkeeping: submit/commit tick per block slot (for
+        # op->serializable-commit latency) and safe-op flags for acks
+        self.submit_tick = np.full((w, n), -1, np.int64)
+        self.commit_tick = np.full((w, n), -1, np.int64)
+        self.safe_host = np.zeros((w, n, self.B), bool)
+        self.last_safe_acks = np.zeros((w, n, self.B), bool)
+        self.tick_count = 0
+
+        self._jit_submit = jax.jit(self._submit_device)
+        self._jit_tick = jax.jit(self._tick_device, static_argnames=("sync_commit",))
+
+    # -- device programs ---------------------------------------------------
+
+    def _submit_device(self, prospective, dag_state, ops_buffer, buffer_filled,
+                       prosp_applied, ops: base.OpBatch):
+        cfg = self.cfg
+        n = cfg.num_nodes
+        vs = jnp.arange(n)
+        r = dag_state["node_round"]  # the round the next block will occupy
+
+        # Reject ops for sealed slots: the block already exists (stalled
+        # node) OR a batch was already buffered for this round and not yet
+        # blockified (double submit between ticks). The reference
+        # re-queues; here the host resubmits on a False accept bit
+        # (DAG.cs:774-812).
+        accepted = (~dag_state["block_exists"][r, vs]
+                    & ~buffer_filled[r, vs])  # [N]
+        acc_ops = {
+            f: jnp.where(accepted[:, None], ops[f], base.OP_NOOP if f == "op" else 0)
+            for f in base.OP_FIELDS
+        }
+        for name, width in self.extra_widths.items():
+            acc_ops[name] = jnp.zeros((n, self.B, width), jnp.int32)
+        # effect capture against the origin's pre-apply prospective state
+        if self.spec.prepare_ops is not None:
+            acc_ops = jax.vmap(self.spec.prepare_ops)(prospective, acc_ops)
+
+        def buf_set(f):
+            cur = ops_buffer[f][r, vs]
+            acc = accepted.reshape((n,) + (1,) * (acc_ops[f].ndim - 1))
+            return ops_buffer[f].at[r, vs].set(jnp.where(acc, acc_ops[f], cur))
+
+        new_buffer = {f: buf_set(f) for f in ops_buffer}
+        new_filled = buffer_filled.at[r, vs].max(accepted)
+
+        # origin applies its own (accepted) ops immediately — the
+        # prospective fast path
+        new_prosp = jax.vmap(self.spec.apply_ops)(prospective, acc_ops)
+        new_applied = prosp_applied.at[vs, r, vs].max(accepted)
+        return new_prosp, new_buffer, new_filled, new_applied, accepted
+
+    def _causal_closure(self, dag_state, applied):
+        """Blocks applicable in each view: certificate held, not yet
+        applied, and every referenced predecessor already applied (or
+        becoming applicable this tick, earlier in round order). The
+        reference's predecessor-completeness gate (CheckCertificates,
+        DAG.cs:629-714) — without it, op replay could run ahead of its
+        causal past when certificates arrive out of order."""
+        cfg = self.cfg
+        edges = dag_state["edges"]
+        cert_seen = dag_state["cert_seen"]
+        for _ in range(cfg.num_rounds):
+            ones = jnp.ones_like(applied[:, :1])
+            prev_applied = jnp.concatenate([ones, applied[:, :-1]], axis=1)
+            # viol[v,r,s] = some referenced (r-1,t) not applied in view v
+            viol = jnp.any(
+                edges[None, :, :, :] & ~prev_applied[:, :, None, :], axis=-1
+            )
+            applicable = cert_seen & ~applied & ~viol
+            applied = applied | applicable
+        return applied
+
+    def _tick_device(self, prospective, stable, dag_state, cstate, ops_buffer,
+                     prosp_applied, stable_applied,
+                     active: Optional[jnp.ndarray],
+                     withhold: Optional[jnp.ndarray],
+                     sync_commit: bool = True):
+        cfg = self.cfg
+        dag_state = dagmod.round_step(cfg, dag_state, active, withhold)
+
+        prosp_now = self._causal_closure(dag_state, prosp_applied)
+        new_cert = prosp_now & ~prosp_applied
+        prospective = apply_masked(self.spec, prospective, ops_buffer, new_cert)
+        prosp_applied = prosp_now
+
+        if sync_commit:
+            cstate = tusk.commit_view(cfg, dag_state, cstate, seed=self.seed)
+        # committed sets are causal closures already (Tusk commits a
+        # leader's whole reachable history), so no extra gate is needed
+        new_com = cstate["committed"] & ~stable_applied
+        stable = apply_masked(self.spec, stable, ops_buffer, new_com)
+        stable_applied = stable_applied | cstate["committed"]
+        return prospective, stable, dag_state, cstate, prosp_applied, stable_applied, new_com
+
+    # -- host API ----------------------------------------------------------
+
+    def submit(self, ops: base.OpBatch, safe: Optional[np.ndarray] = None) -> np.ndarray:
+        """Buffer one [N, B] op batch (rides each node's next block) and
+        apply each node's own ops to its prospective state. Returns the
+        [N] accepted mask (False = that node's current block slot is
+        sealed or already buffered; resubmit after the next tick)."""
+        r = np.asarray(self.dag["node_round"])
+        (self.prospective, self.ops_buffer, self.buffer_filled,
+         self.prosp_applied, accepted) = self._jit_submit(
+            self.prospective, self.dag, self.ops_buffer, self.buffer_filled,
+            self.prosp_applied, ops)
+        acc = np.asarray(accepted)
+        vs = np.arange(self.cfg.num_nodes)
+        self.submit_tick[r[acc], vs[acc]] = self.tick_count
+        if safe is not None:
+            self.safe_host[r[acc], vs[acc]] = np.asarray(safe, bool)[acc]
+        return acc
+
+    def tick(self, active=None, withhold=None) -> np.ndarray:
+        """One protocol round + state application. Returns the [N, W, N]
+        mask of blocks newly committed per node view this tick (the
+        safe-update completion signal: a node's safe ops are acked when
+        its own block commits in its own view)."""
+        (self.prospective, self.stable, self.dag, self.commit,
+         self.prosp_applied, self.stable_applied, new_com) = self._jit_tick(
+            self.prospective, self.stable, self.dag, self.commit,
+            self.ops_buffer, self.prosp_applied, self.stable_applied,
+            active, withhold)
+        self.tick_count += 1
+        new_com = np.asarray(new_com)
+        # op->serializable-commit bookkeeping: a block's latency is
+        # measured when it commits in its *origin's own* view — also the
+        # deferred safe-update ack point (ClientInterface.cs:186-190)
+        own = new_com[np.arange(self.cfg.num_nodes), :, np.arange(self.cfg.num_nodes)].T
+        newly = own & (self.submit_tick >= 0) & (self.commit_tick < 0)
+        self.commit_tick[newly] = self.tick_count
+        self.last_safe_acks = newly[:, :, None] & self.safe_host
+        return new_com
+
+    def safe_acks(self) -> np.ndarray:
+        """[W, N, B] mask of safe ops acked by the latest tick: the op's
+        block committed in its origin's own view (the deferred-reply
+        signal the reference sends per client connection,
+        SafeCRDTManager.safeUpdateCompleteClientNotifier)."""
+        return self.last_safe_acks
+
+    def commit_latencies(self) -> np.ndarray:
+        """Ticks from submit to stable commit in the origin's own view,
+        for every block that has completed the full path."""
+        done = (self.submit_tick >= 0) & (self.commit_tick >= 0)
+        return (self.commit_tick - self.submit_tick)[done]
+
+    def query_prospective(self, name: str, *args):
+        q = self.spec.queries[name]
+        return jax.vmap(q, in_axes=(0,) + (None,) * len(args))(self.prospective, *args)
+
+    def query_stable(self, name: str, *args):
+        q = self.spec.queries[name]
+        return jax.vmap(q, in_axes=(0,) + (None,) * len(args))(self.stable, *args)
+
+    def ordered_commits(self, node: int):
+        return tusk.ordered_blocks(self.cfg, self.commit, node)

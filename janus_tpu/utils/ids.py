@@ -43,6 +43,10 @@ class Interner:
     def lookup(self, ident: int) -> Hashable:
         return self._values[ident]
 
+    def get(self, value: Hashable) -> int | None:
+        """Existing id for a value, or None (no interning side effect)."""
+        return self._ids.get(value)
+
     def __contains__(self, value: Hashable) -> bool:
         return value in self._ids
 

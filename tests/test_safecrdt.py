@@ -1,0 +1,228 @@
+"""SafeCRDT dual-state runtime tests — the analog of the reference's
+full-system suite (Tests/KVStoreTests.cs: 4 complete server stacks in one
+process; prospective convergence :141-159, stable==prospective
+convergence :225-286, safe-update blocking semantics :289-354)."""
+import jax.numpy as jnp
+import numpy as np
+
+from janus_tpu.consensus import DagConfig
+from janus_tpu.models import base, orset, pncounter
+from janus_tpu.runtime.safecrdt import SafeKV
+from janus_tpu.utils.ids import TagMinter
+
+N, W, B, K = 4, 16, 4, 8
+
+
+def make_kv(**kw):
+    return SafeKV(DagConfig(N, W), pncounter.SPEC, ops_per_block=B,
+                  num_keys=K, num_writers=N, **kw)
+
+
+def pnc_ops(key_amounts):
+    """key_amounts: per node, list of (key, amount) — pads to B."""
+    op = np.zeros((N, B), np.int32)
+    key = np.zeros((N, B), np.int32)
+    a0 = np.zeros((N, B), np.int32)
+    writer = np.broadcast_to(np.arange(N, dtype=np.int32)[:, None], (N, B)).copy()
+    for v, pairs in enumerate(key_amounts):
+        for b, (k, a) in enumerate(pairs):
+            op[v, b] = pncounter.OP_INC if a >= 0 else pncounter.OP_DEC
+            key[v, b] = k
+            a0[v, b] = abs(a)
+    return base.make_op_batch(op=op, key=key, a0=a0, writer=writer)
+
+
+def test_local_update_is_immediately_prospective():
+    kv = make_kv()
+    acc = kv.submit(pnc_ops([[(0, 5)], [], [], []]))
+    assert acc.all()
+    vals = np.asarray(kv.query_prospective("get"))  # [N, K]
+    assert vals[0, 0] == 5          # origin sees it instantly
+    assert (vals[1:, 0] == 0).all()  # others haven't yet
+    assert (np.asarray(kv.query_stable("get")) == 0).all()
+
+
+def test_prospective_converges_after_certification():
+    kv = make_kv()
+    kv.submit(pnc_ops([[(0, 5)], [(1, 3)], [], []]))
+    kv.tick()  # round 0: blocks created, certified, delivered
+    vals = np.asarray(kv.query_prospective("get"))
+    assert (vals[:, 0] == 5).all() and (vals[:, 1] == 3).all()
+
+
+def test_stable_lags_then_matches_prospective():
+    kv = make_kv()
+    kv.submit(pnc_ops([[(0, 5)], [(1, 3)], [(2, -2)], []]))
+    committed_any = False
+    for _ in range(4):
+        new_com = kv.tick()
+        committed_any = committed_any or new_com.any()
+    assert committed_any
+    stable = np.asarray(kv.query_stable("get"))
+    prosp = np.asarray(kv.query_prospective("get"))
+    np.testing.assert_array_equal(stable, prosp)
+    assert stable[0, 0] == 5 and stable[0, 2] == -2
+    # all nodes' stable states identical
+    assert (stable == stable[0]).all()
+
+
+def test_safe_update_completion_signal_and_latency():
+    kv = make_kv()
+    safe = np.zeros((N, B), bool)
+    safe[0, 0] = True
+    acc = kv.submit(pnc_ops([[(3, 7)], [], [], []]), safe=safe)
+    assert acc[0]
+    waited = None
+    for i in range(6):
+        new_com = kv.tick()
+        # node 0's own block (round 0, source 0) committed in its own view?
+        if new_com[0, 0, 0]:
+            waited = i + 1
+            break
+    assert waited is not None, "safe update never committed"
+    lats = kv.commit_latencies()
+    assert len(lats) >= 1 and (lats >= 1).all()
+    # the safe op's effect is in stable state everywhere
+    assert (np.asarray(kv.query_stable("get"))[:, 3] == 7).all()
+
+
+def test_continuous_load_converges_and_orders_identically():
+    kv = make_kv()
+    rng = np.random.default_rng(1)
+    for t in range(10):
+        pairs = [[(int(rng.integers(0, K)), int(rng.integers(-4, 5)))]
+                 for _ in range(N)]
+        kv.submit(pnc_ops(pairs))
+        kv.tick()
+    for _ in range(3):
+        kv.tick()  # drain
+    stable = np.asarray(kv.query_stable("get"))
+    assert (stable == stable[0]).all()
+    orders = [kv.ordered_commits(v) for v in range(N)]
+    shortest = min(len(o) for o in orders)
+    assert shortest > 0
+    for o in orders:
+        assert o[:shortest] == orders[0][:shortest]
+
+
+def test_stalled_node_submit_rejected():
+    kv = make_kv()
+    # stall: only nodes 0,1 active -> no quorum -> no cert -> no advance
+    act = jnp.asarray([True, True, False, False])
+    kv.submit(pnc_ops([[(0, 1)], [(0, 1)], [], []]))
+    kv.tick(active=act)
+    # blocks for round 0 now exist but the cluster cannot advance;
+    # resubmitting targets the same sealed slot -> rejected
+    acc = kv.submit(pnc_ops([[(0, 9)], [], [], []]))
+    assert not acc[0]
+    vals = np.asarray(kv.query_prospective("get"))
+    assert vals[0, 0] == 1  # rejected ops did not apply locally either
+
+
+def test_orset_safekv_add_remove_consensus():
+    kv = SafeKV(DagConfig(N, W), orset.SPEC, ops_per_block=B,
+                num_keys=4, capacity=16)
+    minters = [TagMinter(v) for v in range(N)]
+    op = np.zeros((N, B), np.int32)
+    key = np.zeros((N, B), np.int32)
+    a0 = np.zeros((N, B), np.int32)
+    a1 = np.zeros((N, B), np.int32)
+    a2 = np.zeros((N, B), np.int32)
+    for v in range(N):
+        t = minters[v].mint_many(1)[0]
+        op[v, 0] = orset.OP_ADD
+        key[v, 0] = 1
+        a0[v, 0] = 42
+        a1[v, 0], a2[v, 0] = t
+    kv.submit(base.make_op_batch(op=op, key=key, a0=a0, a1=a1, a2=a2))
+    for _ in range(4):
+        kv.tick()
+    assert np.asarray(kv.query_prospective("contains", 1, 42)).all()
+    assert np.asarray(kv.query_stable("contains", 1, 42)).all()
+    # remove everywhere via one node, then converge
+    op2 = np.zeros((N, B), np.int32)
+    key2 = np.zeros((N, B), np.int32)
+    a02 = np.zeros((N, B), np.int32)
+    op2[0, 0] = orset.OP_REMOVE
+    key2[0, 0] = 1
+    a02[0, 0] = 42
+    kv.submit(base.make_op_batch(op=op2, key=key2, a0=a02))
+    for _ in range(4):
+        kv.tick()
+    assert not np.asarray(kv.query_stable("contains", 1, 42)).any()
+
+
+def test_keyspace_assignment_and_capacity():
+    from janus_tpu.runtime.keyspace import KeySpace
+    ks = KeySpace({"pnc": 2, "orset": 4})
+    assert ks.create("pnc", "alice") == 0
+    assert ks.create("pnc", "bob") == 1
+    assert ks.create("pnc", "alice") == 0  # idempotent
+    assert ks.lookup("pnc", "carol") is None
+    slot, existed = ks.resolve("pnc", "bob")
+    assert slot == 1 and existed
+    try:
+        ks.create("pnc", "carol")
+        assert False, "expected capacity error"
+    except KeyError:
+        pass
+    assert ks.create("orset", "carol") == 0  # independent per type
+
+
+def test_orset_frontier_replay_commutes_model_level():
+    """Regression: remove must tombstone what the *origin observed*
+    (captured frontier), not whatever is present at apply time, so that
+    replicas applying [add, remove] vs [remove, add] converge."""
+    import jax
+    origin = orset.init(1, 8)
+    origin = orset.apply_ops(origin, base.make_op_batch(
+        op=[orset.OP_ADD], key=[0], a0=[7], a1=[0], a2=[1]))
+    rm = base.make_op_batch(op=[orset.OP_REMOVE], key=[0], a0=[7])
+    rm["frontier"] = np.zeros((1, 4), np.int32)
+    rm = orset.prepare_ops(origin, rm)
+    assert rm["frontier"][0, 0] == 1  # observed tag (0, 1)
+    add2 = base.make_op_batch(op=[orset.OP_ADD], key=[0], a0=[7], a1=[0], a2=[2])
+    add2["frontier"] = np.zeros((1, 4), np.int32)
+
+    fresh = orset.init(1, 8)
+    a_then_r = orset.apply_ops(orset.apply_ops(fresh, add2), rm)
+    r_then_a = orset.apply_ops(orset.apply_ops(fresh, rm), add2)
+    # both orders: tag (0,2) survives (> frontier), element present
+    assert bool(orset.contains(a_then_r, 0, 7))
+    assert bool(orset.contains(r_then_a, 0, 7))
+
+
+def test_safekv_concurrent_add_remove_no_divergence():
+    """The review repro: concurrent ADD and REMOVE with skewed delivery
+    must leave all replicas agreeing once fully synced."""
+    kv = SafeKV(DagConfig(N, W), orset.SPEC, ops_per_block=B,
+                num_keys=2, capacity=16)
+    minters = [TagMinter(v) for v in range(N)]
+    # node 0 adds elem 42; everyone learns it
+    op = np.zeros((N, B), np.int32); key = np.zeros((N, B), np.int32)
+    a0 = np.zeros((N, B), np.int32); a1 = np.zeros((N, B), np.int32)
+    a2 = np.zeros((N, B), np.int32)
+    t = minters[0].mint_many(1)[0]
+    op[0, 0], key[0, 0], a0[0, 0] = orset.OP_ADD, 1, 42
+    a1[0, 0], a2[0, 0] = t
+    kv.submit(base.make_op_batch(op=op, key=key, a0=a0, a1=a1, a2=a2))
+    kv.tick(); kv.tick()
+    # concurrent: node 1 removes 42, node 0 re-adds with a fresh tag
+    op2 = np.zeros((N, B), np.int32); key2 = np.zeros((N, B), np.int32)
+    a02 = np.zeros((N, B), np.int32); a12 = np.zeros((N, B), np.int32)
+    a22 = np.zeros((N, B), np.int32)
+    t2 = minters[0].mint_many(1)[0]
+    op2[0, 0], key2[0, 0], a02[0, 0] = orset.OP_ADD, 1, 42
+    a12[0, 0], a22[0, 0] = t2
+    op2[1, 0], key2[1, 0], a02[1, 0] = orset.OP_REMOVE, 1, 42
+    kv.submit(base.make_op_batch(op=op2, key=key2, a0=a02, a1=a12, a2=a22))
+    import jax.numpy as jnp
+    crash = jnp.asarray([True, True, True, False])
+    kv.tick(active=crash)   # one degraded round
+    for _ in range(4):
+        kv.tick()           # full recovery + drain
+    prosp = np.asarray(kv.query_prospective("contains", 1, 42))
+    stable = np.asarray(kv.query_stable("contains", 1, 42))
+    assert (prosp == prosp[0]).all(), prosp
+    assert (stable == stable[0]).all(), stable
+    assert prosp[0]  # add-wins: the fresh re-add tag survives
