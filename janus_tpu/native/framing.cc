@@ -1,0 +1,59 @@
+// Base128 (varint) length-prefixed framing, protobuf-net compatible in
+// shape: a length-delimited tag byte (field<<3 | wiretype 2), a varint
+// payload length, then the payload (reference send side CMNode.cs:81,
+// recv side ManagerServer.cs:99; the client plane uses field number 1).
+#include "janus_native.h"
+
+namespace {
+
+int put_varint(uint64_t v, uint8_t* out) {
+  int n = 0;
+  do {
+    uint8_t b = v & 0x7f;
+    v >>= 7;
+    out[n++] = b | (v ? 0x80 : 0);
+  } while (v);
+  return n;
+}
+
+// returns bytes consumed, 0 if incomplete
+int get_varint(const uint8_t* buf, int len, uint64_t* out) {
+  uint64_t v = 0;
+  for (int i = 0; i < len && i < 10; i++) {
+    v |= uint64_t(buf[i] & 0x7f) << (7 * i);
+    if (!(buf[i] & 0x80)) {
+      *out = v;
+      return i + 1;
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" int janus_frame_encode(const uint8_t* payload, int len, int field,
+                                  uint8_t* out, int out_cap) {
+  uint8_t hdr[12];
+  int h = 0;
+  h += put_varint(uint64_t(field) << 3 | 2, hdr + h);
+  h += put_varint(uint64_t(len), hdr + h);
+  if (h + len > out_cap) return -1;
+  for (int i = 0; i < h; i++) out[i] = hdr[i];
+  for (int i = 0; i < len; i++) out[h + i] = payload[i];
+  return h + len;
+}
+
+extern "C" int janus_frame_decode(const uint8_t* buf, int len, int* off,
+                                  int* plen) {
+  uint64_t tag = 0, n = 0;
+  int a = get_varint(buf, len, &tag);
+  if (a == 0) return 0;
+  if ((tag & 7) != 2) return -1;  // only length-delimited frames
+  int b = get_varint(buf + a, len - a, &n);
+  if (b == 0) return 0;
+  if (n > uint64_t(1) << 30) return -2;  // 1 GiB sanity cap
+  if (a + b + int(n) > len) return 0;    // incomplete
+  *off = a + b;
+  *plen = int(n);
+  return a + b + int(n);
+}

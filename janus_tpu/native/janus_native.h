@@ -1,0 +1,91 @@
+/* C API of the janus-tpu native host runtime.
+ *
+ * The native side owns the wire boundary the reference implements in C#
+ * managed code: Base128 length-prefixed protobuf framing
+ * (MergeSharp.TCPConnectionManager framing; BFT-CRDT/Network/CMNode.cs:81,
+ * ManagerServer.cs:99), the client-interface TCP server
+ * (BFT-CRDT/Network/ClientInterface.cs), and the request batching +
+ * key/element interning that turns wire messages into dense int32 op
+ * records ready for device tensors (the SafeCRDTManager batching loop,
+ * SafeCRDTManager.cs:164-198, recast as a native data loader).
+ *
+ * Everything crosses this API as plain C types for ctypes binding.
+ */
+#ifndef JANUS_NATIVE_H_
+#define JANUS_NATIVE_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- SHA-256 (block/update digests; reference Block.ComputeDigest,
+ * DAGConsensus/Block.cs:45-73) ---- */
+void janus_sha256(const uint8_t* data, size_t len, uint8_t out32[32]);
+
+/* ---- ECDSA P-256 via the system libcrypto (dlopen'd; no headers).
+ * Returns 0 on success, negative on error/unavailable. Keys/sigs are DER
+ * blobs. (reference: Replica ECDSA keypair, DAGConsensus/Replica.cs:34-42,
+ * Block.Sign/Verify :75-88) ---- */
+int janus_ecdsa_available(void);
+int janus_ecdsa_keygen(uint8_t* priv_der, int* priv_len /*in:cap out:len*/,
+                       uint8_t* pub_der, int* pub_len);
+int janus_ecdsa_sign(const uint8_t* priv_der, int priv_len,
+                     const uint8_t* msg, size_t msg_len,
+                     uint8_t* sig_der, int* sig_len);
+int janus_ecdsa_verify(const uint8_t* pub_der, int pub_len,
+                       const uint8_t* msg, size_t msg_len,
+                       const uint8_t* sig_der, int sig_len);
+
+/* ---- varint framing (Base128 length prefix, protobuf-net compatible
+ * shape: tag byte (field<<3|2), varint length, payload) ---- */
+int janus_frame_encode(const uint8_t* payload, int len, int field,
+                       uint8_t* out, int out_cap);
+/* Returns bytes consumed, 0 if incomplete, negative on malformed.
+ * Writes payload offset/length into *off/*plen. */
+int janus_frame_decode(const uint8_t* buf, int len, int* off, int* plen);
+
+/* ---- client-interface server ---- */
+typedef struct JanusServer JanusServer;
+
+JanusServer* janus_server_create(const char* bind_addr, int port,
+                                 int max_clients);
+int  janus_server_port(JanusServer* s); /* actual port (0 -> ephemeral) */
+int  janus_server_start(JanusServer* s);
+void janus_server_stop(JanusServer* s);
+void janus_server_destroy(JanusServer* s);
+
+/* Register a replicated type (e.g. "pnc", "orset"); returns type id. */
+int janus_server_register_type(JanusServer* s, const char* type_code,
+                               int key_capacity);
+
+/* Drain up to `cap` parsed ops into caller arrays. Returns count.
+ * op_code packs up to two ASCII letters little-endian ('g'|'p'<<8).
+ * client_tag = (conn_id << 32) | sequenceNumber, for reply routing.
+ * p0..p2: numeric params parsed as int64; non-numeric params are
+ * interned (shared value table) and returned as ids with bit 62 set. */
+int janus_server_poll_batch(JanusServer* s, int cap,
+                            int32_t* type_id, int32_t* key_slot,
+                            int32_t* op_code, uint8_t* is_safe,
+                            int64_t* p0, int64_t* p1, int64_t* p2,
+                            uint64_t* client_tag);
+
+/* Number of distinct keys seen for a type (key_slot ids are dense). */
+int janus_server_key_count(JanusServer* s, int type_id);
+
+/* Send a reply frame for a drained op. result/response are strings
+ * (reference ClientMessage.result/.response). Returns 0 on success. */
+int janus_server_reply(JanusServer* s, uint64_t client_tag,
+                       const char* result, const char* response);
+
+/* Counters for observability (PerfCounter analog, Utlis/PerfCounter.cs). */
+long long janus_server_ops_received(JanusServer* s);
+long long janus_server_replies_sent(JanusServer* s);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* JANUS_NATIVE_H_ */

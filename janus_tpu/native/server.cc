@@ -1,0 +1,418 @@
+// Client-interface TCP server + request batching queue.
+//
+// The native re-implementation of the reference's managed server plane:
+// per-client receive path and reply routing (BFT-CRDT/Network/
+// ClientInterface.cs:130-272), protobuf ClientMessage decode
+// (Network/ClientMessages.cs:13-34), and the request batching that feeds
+// the execution engine (SafeCRDTManager.ActualPropagateSyncMsg,
+// CRDTManagers/SafeCRDTManager.cs:164-198). Instead of dictionaries and
+// per-connection managed threads, one poll loop parses frames straight
+// into dense int records (keys and string params interned to stable ids)
+// that the Python driver hands to the device program as op tensors.
+//
+// ClientMessage wire schema (field numbers fixed by this implementation;
+// names/semantics follow the reference):
+//   1 sourceType   varint
+//   2 sequence     varint
+//   3 key          string
+//   4 typeCode     string
+//   5 opCode       string ("s","i","d","a","r","c","gp","gs",...)
+//   6 isSafe       varint bool
+//   7 params       repeated string
+//   8 result       string   (reply)
+//   9 response     string   (reply; "su" marks a deferred safe-update ack)
+#include "janus_native.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kInternBit = 1ull << 62;
+
+struct Op {
+  int32_t type_id;
+  int32_t key_slot;
+  int32_t op_code;
+  uint8_t is_safe;
+  int64_t p[3];
+  uint64_t client_tag;
+};
+
+struct Conn {
+  int fd = -1;
+  std::vector<uint8_t> inbuf;
+};
+
+struct TypeSpace {
+  std::string code;
+  int capacity;
+  std::unordered_map<std::string, int32_t> keys;
+};
+
+int put_varint(uint64_t v, std::vector<uint8_t>& out) {
+  int n = 0;
+  do {
+    uint8_t b = v & 0x7f;
+    v >>= 7;
+    out.push_back(b | (v ? 0x80 : 0));
+    n++;
+  } while (v);
+  return n;
+}
+
+void put_str(int field, const std::string& s, std::vector<uint8_t>& out) {
+  put_varint(uint64_t(field) << 3 | 2, out);
+  put_varint(s.size(), out);
+  out.insert(out.end(), s.begin(), s.end());
+}
+
+void put_uint(int field, uint64_t v, std::vector<uint8_t>& out) {
+  put_varint(uint64_t(field) << 3 | 0, out);
+  put_varint(v, out);
+}
+
+struct Parsed {
+  uint64_t seq = 0;
+  std::string key, type_code, op_code;
+  bool is_safe = false;
+  std::vector<std::string> params;
+};
+
+bool get_varint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
+  uint64_t v = 0;
+  for (int i = 0; p < end && i < 10; i++) {
+    uint8_t b = *p++;
+    v |= uint64_t(b & 0x7f) << (7 * i);
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+  }
+  return false;
+}
+
+bool parse_client_message(const uint8_t* p, int len, Parsed* m) {
+  const uint8_t* end = p + len;
+  while (p < end) {
+    uint64_t tag;
+    if (!get_varint(p, end, &tag)) return false;
+    int field = int(tag >> 3), wt = int(tag & 7);
+    if (wt == 0) {
+      uint64_t v;
+      if (!get_varint(p, end, &v)) return false;
+      if (field == 2) m->seq = v;
+      if (field == 6) m->is_safe = v != 0;
+    } else if (wt == 2) {
+      uint64_t n;
+      if (!get_varint(p, end, &n) || p + n > end) return false;
+      std::string s(reinterpret_cast<const char*>(p), size_t(n));
+      p += n;
+      switch (field) {
+        case 3: m->key = std::move(s); break;
+        case 4: m->type_code = std::move(s); break;
+        case 5: m->op_code = std::move(s); break;
+        case 7: m->params.push_back(std::move(s)); break;
+        default: break;  // result/response ignored inbound
+      }
+    } else {
+      return false;  // unsupported wire type
+    }
+  }
+  return true;
+}
+
+bool parse_int(const std::string& s, int64_t* out) {
+  if (s.empty()) return false;
+  size_t i = (s[0] == '-' || s[0] == '+') ? 1 : 0;
+  if (i == s.size()) return false;
+  int64_t v = 0;
+  for (; i < s.size(); i++) {
+    if (s[i] < '0' || s[i] > '9') return false;
+    v = v * 10 + (s[i] - '0');
+  }
+  *out = (s[0] == '-') ? -v : v;
+  return true;
+}
+
+}  // namespace
+
+struct JanusServer {
+  std::string addr;
+  int port;
+  int max_clients;
+  int listen_fd = -1;
+  std::thread io;
+  std::atomic<bool> running{false};
+
+  std::mutex mu;  // guards queue, conns, types, interner
+  std::deque<Op> queue;
+  std::unordered_map<uint32_t, Conn> conns;
+  uint32_t next_conn_id = 1;
+  std::vector<TypeSpace> types;
+  std::unordered_map<std::string, int32_t> values;  // param interner
+  std::atomic<long long> ops_in{0}, replies_out{0};
+
+  int type_id_of(const std::string& code) {
+    for (size_t i = 0; i < types.size(); i++)
+      if (types[i].code == code) return int(i);
+    return -1;
+  }
+
+  void io_loop();
+  void handle_payload(uint32_t cid, const uint8_t* p, int len);
+};
+
+void JanusServer::handle_payload(uint32_t cid, const uint8_t* p, int len) {
+  Parsed m;
+  if (!parse_client_message(p, len, &m)) return;
+  Op op{};
+  op.client_tag = (uint64_t(cid) << 32) | (m.seq & 0xffffffff);
+  {
+    std::lock_guard<std::mutex> lk(mu);
+    int tid = type_id_of(m.type_code);
+    if (tid < 0) return;  // unknown type: drop (reference logs + ignores)
+    TypeSpace& ts = types[size_t(tid)];
+    auto it = ts.keys.find(m.key);
+    int32_t slot;
+    if (it != ts.keys.end()) {
+      slot = it->second;
+    } else {
+      if (int(ts.keys.size()) >= ts.capacity) return;  // keyspace full
+      slot = int32_t(ts.keys.size());
+      ts.keys.emplace(m.key, slot);
+    }
+    op.type_id = tid;
+    op.key_slot = slot;
+    op.op_code = m.op_code.empty()
+                     ? 0
+                     : (int32_t(uint8_t(m.op_code[0])) |
+                        (m.op_code.size() > 1
+                             ? int32_t(uint8_t(m.op_code[1])) << 8
+                             : 0));
+    op.is_safe = m.is_safe ? 1 : 0;
+    for (size_t i = 0; i < 3 && i < m.params.size(); i++) {
+      int64_t v;
+      if (parse_int(m.params[i], &v)) {
+        op.p[i] = v;
+      } else {
+        auto vit = values.find(m.params[i]);
+        int32_t vid;
+        if (vit != values.end()) {
+          vid = vit->second;
+        } else {
+          vid = int32_t(values.size());
+          values.emplace(m.params[i], vid);
+        }
+        op.p[i] = int64_t(uint64_t(vid) | kInternBit);
+      }
+    }
+    queue.push_back(op);
+  }
+  ops_in.fetch_add(1, std::memory_order_relaxed);
+}
+
+void JanusServer::io_loop() {
+  while (running.load(std::memory_order_relaxed)) {
+    std::vector<pollfd> fds;
+    std::vector<uint32_t> ids;
+    fds.push_back({listen_fd, POLLIN, 0});
+    ids.push_back(0);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      for (auto& [cid, c] : conns) {
+        fds.push_back({c.fd, POLLIN, 0});
+        ids.push_back(cid);
+      }
+    }
+    int rc = ::poll(fds.data(), nfds_t(fds.size()), 50);
+    if (rc <= 0) continue;
+
+    if (fds[0].revents & POLLIN) {
+      int cfd = ::accept(listen_fd, nullptr, nullptr);
+      if (cfd >= 0) {
+        int one = 1;
+        setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        std::lock_guard<std::mutex> lk(mu);
+        if (int(conns.size()) < max_clients) {
+          Conn c;
+          c.fd = cfd;
+          conns.emplace(next_conn_id++, std::move(c));
+        } else {
+          ::close(cfd);
+        }
+      }
+    }
+    for (size_t i = 1; i < fds.size(); i++) {
+      if (!(fds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      uint8_t tmp[65536];
+      ssize_t n = ::recv(fds[i].fd, tmp, sizeof tmp, 0);
+      if (n <= 0) {
+        std::lock_guard<std::mutex> lk(mu);
+        ::close(fds[i].fd);
+        conns.erase(ids[i]);
+        continue;
+      }
+      std::vector<uint8_t>* buf;
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        auto it = conns.find(ids[i]);
+        if (it == conns.end()) continue;
+        buf = &it->second.inbuf;
+        buf->insert(buf->end(), tmp, tmp + n);
+      }
+      // frame extraction (buffer only touched by this thread)
+      int off = 0;
+      while (true) {
+        int poff, plen;
+        int used = janus_frame_decode(buf->data() + off, int(buf->size()) - off,
+                                      &poff, &plen);
+        if (used <= 0) {
+          if (used < 0) off = int(buf->size());  // malformed: drop buffer
+          break;
+        }
+        handle_payload(ids[i], buf->data() + off + poff, plen);
+        off += used;
+      }
+      if (off > 0) buf->erase(buf->begin(), buf->begin() + off);
+    }
+  }
+}
+
+extern "C" JanusServer* janus_server_create(const char* bind_addr, int port,
+                                            int max_clients) {
+  auto* s = new JanusServer;
+  s->addr = bind_addr ? bind_addr : "127.0.0.1";
+  s->port = port;
+  s->max_clients = max_clients > 0 ? max_clients : 64;
+  return s;
+}
+
+extern "C" int janus_server_start(JanusServer* s) {
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) return -1;
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(uint16_t(s->port));
+  if (::inet_pton(AF_INET, s->addr.c_str(), &sa.sin_addr) != 1) return -2;
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&sa), sizeof sa) < 0)
+    return -3;
+  if (s->port == 0) {
+    socklen_t slen = sizeof sa;
+    getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&sa), &slen);
+    s->port = ntohs(sa.sin_port);
+  }
+  if (::listen(s->listen_fd, 64) < 0) return -4;
+  s->running.store(true);
+  s->io = std::thread([s] { s->io_loop(); });
+  return 0;
+}
+
+extern "C" int janus_server_port(JanusServer* s) { return s->port; }
+
+extern "C" void janus_server_stop(JanusServer* s) {
+  if (!s->running.exchange(false)) return;
+  if (s->io.joinable()) s->io.join();
+  if (s->listen_fd >= 0) ::close(s->listen_fd);
+  std::lock_guard<std::mutex> lk(s->mu);
+  for (auto& [cid, c] : s->conns) ::close(c.fd);
+  s->conns.clear();
+}
+
+extern "C" void janus_server_destroy(JanusServer* s) {
+  janus_server_stop(s);
+  delete s;
+}
+
+extern "C" int janus_server_register_type(JanusServer* s,
+                                          const char* type_code,
+                                          int key_capacity) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  int existing = s->type_id_of(type_code);
+  if (existing >= 0) return existing;
+  TypeSpace ts;
+  ts.code = type_code;
+  ts.capacity = key_capacity;
+  s->types.push_back(std::move(ts));
+  return int(s->types.size()) - 1;
+}
+
+extern "C" int janus_server_poll_batch(JanusServer* s, int cap,
+                                       int32_t* type_id, int32_t* key_slot,
+                                       int32_t* op_code, uint8_t* is_safe,
+                                       int64_t* p0, int64_t* p1, int64_t* p2,
+                                       uint64_t* client_tag) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  int n = 0;
+  while (n < cap && !s->queue.empty()) {
+    const Op& op = s->queue.front();
+    type_id[n] = op.type_id;
+    key_slot[n] = op.key_slot;
+    op_code[n] = op.op_code;
+    is_safe[n] = op.is_safe;
+    p0[n] = op.p[0];
+    p1[n] = op.p[1];
+    p2[n] = op.p[2];
+    client_tag[n] = op.client_tag;
+    s->queue.pop_front();
+    n++;
+  }
+  return n;
+}
+
+extern "C" int janus_server_key_count(JanusServer* s, int type_id) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (type_id < 0 || type_id >= int(s->types.size())) return -1;
+  return int(s->types[size_t(type_id)].keys.size());
+}
+
+extern "C" int janus_server_reply(JanusServer* s, uint64_t client_tag,
+                                  const char* result, const char* response) {
+  std::vector<uint8_t> body;
+  put_uint(2, client_tag & 0xffffffff, body);
+  if (result && *result) put_str(8, result, body);
+  if (response && *response) put_str(9, response, body);
+  std::vector<uint8_t> frame(body.size() + 12);
+  int fl = janus_frame_encode(body.data(), int(body.size()), 1, frame.data(),
+                              int(frame.size()));
+  if (fl < 0) return -1;
+
+  int fd;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    auto it = s->conns.find(uint32_t(client_tag >> 32));
+    if (it == s->conns.end()) return -2;
+    fd = it->second.fd;
+  }
+  ssize_t off = 0;
+  while (off < fl) {
+    ssize_t n = ::send(fd, frame.data() + off, size_t(fl - off), MSG_NOSIGNAL);
+    if (n <= 0) return -3;
+    off += n;
+  }
+  s->replies_out.fetch_add(1, std::memory_order_relaxed);
+  return 0;
+}
+
+extern "C" long long janus_server_ops_received(JanusServer* s) {
+  return s->ops_in.load(std::memory_order_relaxed);
+}
+
+extern "C" long long janus_server_replies_sent(JanusServer* s) {
+  return s->replies_out.load(std::memory_order_relaxed);
+}
