@@ -8,14 +8,18 @@ from janus_tpu.consensus.dag import (  # noqa: F401
     deliver_certificates,
     form_certificates,
     init,
+    recycle,
     round_step,
     sign_blocks,
+    slot_of,
     structural_validity,
 )
 from janus_tpu.consensus.tusk import (  # noqa: F401
     commit_view,
     init_commit,
+    leader_of,
     leaders,
     order_key,
     ordered_blocks,
+    recycle_commit,
 )

@@ -1,14 +1,22 @@
-"""Narwhal-style DAG mempool as a tensor program.
+"""Narwhal-style DAG mempool as a tensor program, over a ring-buffered
+round window so the protocol runs forever in bounded memory.
 
 Reference: BFT-CRDT/DAGConsensus/DAG.cs — per-node threads, dictionaries
 and locks: block creation/batching in AdvanceRoundLoop (:720-822), block
 validation + signature acks (ReceivedBlock :413-472), certificate
 formation at 2f+1 acks (ReceivedSignature :495-568), round advancement at
 2f+1 certificates (CheckCertificates :629-714), faulty-rate certificate
-withholding (:544-561).
+withholding (:544-561), garbage collection of rounds committed everywhere
+(GarbageCollect :946-965).
 
 Tensor re-design: an emulated N-node cluster is ONE state pytree; a block
-is a (round, source) slot; every protocol rule is a masked reduction:
+is a (round, source) slot; every protocol rule is a masked reduction.
+Logical rounds are unbounded; round r lives in slot ``r % W`` of a static
+W-deep ring. A slot is recycled (cleared, ``slot_round += W``) when the
+GC frontier ``base_round`` passes its round — the tensor analog of the
+reference's GarbageCollect, with creation back-pressure (a node cannot
+create a block for round >= base_round + W) standing in for its bounded
+mempool.
 
     edges        bool[W, N, N]   block (r,s) references cert of (r-1,t)
                                  (global truth: edge content is fixed at
@@ -18,17 +26,18 @@ is a (round, source) slot; every protocol rule is a masked reduction:
     acks         bool[W, N, N]   signer t has acked block (r,s)
     cert_exists  bool[W, N]      2f+1 acks assembled by the creator
     cert_seen    bool[N, W, N]   node v holds the certificate of (r,s)
-    node_round   int32[N]        current round per node
+    node_round   int32[N]        current (logical) round per node
+    slot_round   int32[W]        logical round currently owning each slot
+    base_round   int32[]         GC frontier: lowest live logical round
 
 Asynchrony — the reference's per-message hand-delivery in its tests
 (Tests/DAGTests.cs SimpleDAGMsgTestSender) — is expressed by *delivery
 masks*: each phase function takes an optional bool mask selecting which
-(recipient, round, source) messages land this call. Passing no mask gives
-the synchronous fast path (everything delivers), which is one XLA program
-per round. Equivocation is structurally impossible here (one slot per
-(round, source)); invalid-block pruning reduces to the structural
-validity mask. W is a static round window; quorum = 2f+1, f=(n-1)//3
-(DAG.cs:117).
+(recipient, round-slot, source) messages land this call. Passing no mask
+gives the synchronous fast path (everything delivers), which is one XLA
+program per round. Equivocation is structurally impossible here (one slot
+per (round, source)); invalid-block pruning reduces to the structural
+validity mask. Quorum = 2f+1, f=(n-1)//3 (DAG.cs:117).
 """
 from __future__ import annotations
 
@@ -43,7 +52,7 @@ State = Dict[str, jnp.ndarray]
 @dataclasses.dataclass(frozen=True)
 class DagConfig:
     num_nodes: int
-    num_rounds: int  # static window W
+    num_rounds: int  # static ring window W (live rounds at any moment)
 
     @property
     def f(self) -> int:
@@ -64,7 +73,14 @@ def init(cfg: DagConfig) -> State:
         "cert_exists": jnp.zeros((w, n), bool),
         "cert_seen": jnp.zeros((n, w, n), bool),
         "node_round": jnp.zeros((n,), jnp.int32),
+        "slot_round": jnp.arange(w, dtype=jnp.int32),
+        "base_round": jnp.int32(0),
     }
+
+
+def slot_of(cfg: DagConfig, r):
+    """Ring slot of logical round r (r may be traced)."""
+    return jnp.asarray(r, jnp.int32) % cfg.num_rounds
 
 
 def _all_mask(cfg: DagConfig):
@@ -77,28 +93,35 @@ def create_blocks(cfg: DagConfig, state: State, active: Optional[jnp.ndarray] = 
     certificate the creator holds for round r-1 (the reference includes
     >=2f+1 prev certs — round advancement guarantees that many are held,
     DAG.cs:774-812). The creator sees its own block and self-acks
-    (CreateBlock self-signature, DAG.cs:896-906)."""
+    (CreateBlock self-signature, DAG.cs:896-906). Creation back-pressure:
+    no block for rounds past the GC window (r >= base_round + W)."""
     n = cfg.num_nodes
     vs = jnp.arange(n)
     r = state["node_round"]
+    s = slot_of(cfg, r)
     act = jnp.ones((n,), bool) if active is None else active
-    fresh = act & ~state["block_exists"][r, vs] & (r < cfg.num_rounds)
+    # both window edges: no block above the GC window (back-pressure) and
+    # none below the frontier (the slot belongs to a future round now)
+    in_window = (r < state["base_round"] + cfg.num_rounds) & (
+        r >= state["base_round"]
+    )
+    fresh = act & ~state["block_exists"][s, vs] & in_window
 
-    prev_r = jnp.maximum(r - 1, 0)
-    prev_certs = state["cert_seen"][vs, prev_r, :]  # [N, N]
+    sp = slot_of(cfg, r - 1)
+    prev_certs = state["cert_seen"][vs, sp, :]  # [N, N]
     new_edges = jnp.where((fresh & (r > 0))[:, None], prev_certs, False)
 
     out = dict(state)
-    out["block_exists"] = state["block_exists"].at[r, vs].max(fresh)
-    out["edges"] = state["edges"].at[r, vs, :].max(new_edges)
-    out["block_seen"] = state["block_seen"].at[vs, r, vs].max(fresh)
-    out["acks"] = state["acks"].at[r, vs, vs].max(fresh)
+    out["block_exists"] = state["block_exists"].at[s, vs].max(fresh)
+    out["edges"] = state["edges"].at[s, vs, :].max(new_edges)
+    out["block_seen"] = state["block_seen"].at[vs, s, vs].max(fresh)
+    out["acks"] = state["acks"].at[s, vs, vs].max(fresh)
     return out
 
 
 def deliver_blocks(cfg: DagConfig, state: State, mask: Optional[jnp.ndarray] = None) -> State:
     """Broadcast: node v receives block (r,s) where mask allows and the
-    block exists (mask axes: [recipient, round, source])."""
+    block exists (mask axes: [recipient, round-slot, source])."""
     m = _all_mask(cfg) if mask is None else mask
     out = dict(state)
     out["block_seen"] = state["block_seen"] | (m & state["block_exists"][None])
@@ -111,14 +134,13 @@ def structural_validity(cfg: DagConfig, state: State) -> jnp.ndarray:
     ReceivedBlock, DAG.cs:413-472 — certs travel inside the block, so the
     check is structural)."""
     refs = jnp.sum(state["edges"], axis=-1)  # [W, N]
-    rounds = jnp.arange(cfg.num_rounds)[:, None]
-    return (rounds == 0) | (refs >= cfg.quorum)
+    return (state["slot_round"][:, None] == 0) | (refs >= cfg.quorum)
 
 
 def sign_blocks(cfg: DagConfig, state: State, mask: Optional[jnp.ndarray] = None) -> State:
     """Every node acks each valid block it has seen; the signature is
     delivered to the block's creator where mask allows (mask axes:
-    [signer, round, source])."""
+    [signer, round-slot, source])."""
     m = _all_mask(cfg) if mask is None else mask
     valid = structural_validity(cfg, state)  # [W, N]
     sigs = state["block_seen"] & valid[None] & m  # [signer, W, N]
@@ -149,7 +171,7 @@ def form_certificates(cfg: DagConfig, state: State, withhold: Optional[jnp.ndarr
 
 
 def deliver_certificates(cfg: DagConfig, state: State, mask: Optional[jnp.ndarray] = None) -> State:
-    """Certificate broadcast (mask axes: [recipient, round, source])."""
+    """Certificate broadcast (mask axes: [recipient, round-slot, source])."""
     m = _all_mask(cfg) if mask is None else mask
     out = dict(state)
     out["cert_seen"] = state["cert_seen"] | (m & state["cert_exists"][None])
@@ -159,14 +181,40 @@ def deliver_certificates(cfg: DagConfig, state: State, mask: Optional[jnp.ndarra
 def advance_rounds(cfg: DagConfig, state: State) -> State:
     """A node advances past round r once it holds 2f+1 certificates for
     round-r blocks (CheckCertificates round-advance signal,
-    DAG.cs:629-714)."""
+    DAG.cs:629-714), bounded by the GC window. A node whose round fell
+    below the GC frontier fast-forwards to it (the lagging-replica
+    catch-up, the BlockQueryMessage repair analog, DAG.cs:612-621)."""
     n = cfg.num_nodes
     vs = jnp.arange(n)
     r = state["node_round"]
-    have = jnp.sum(state["cert_seen"][vs, r, :], axis=-1)
-    ready = (have >= cfg.quorum) & (r + 1 < cfg.num_rounds)
+    s = slot_of(cfg, r)
+    have = jnp.sum(state["cert_seen"][vs, s, :], axis=-1)
+    ready = (have >= cfg.quorum) & (r + 1 < state["base_round"] + cfg.num_rounds)
     out = dict(state)
-    out["node_round"] = r + ready.astype(jnp.int32)
+    out["node_round"] = jnp.maximum(r + ready.astype(jnp.int32),
+                                    state["base_round"])
+    return out
+
+
+def recycle(cfg: DagConfig, state: State, new_base) -> State:
+    """Advance the GC frontier to ``new_base`` and clear every slot whose
+    round fell below it, handing the slot to round ``slot_round + W``
+    (the reference's GarbageCollect: remove rounds committed everywhere,
+    DAG.cs:946-965 — callers are responsible for only passing a
+    ``new_base`` whose rounds are finished everywhere)."""
+    w = cfg.num_rounds
+    new_base = jnp.asarray(new_base, jnp.int32)
+    dead = state["slot_round"] < new_base  # [W]
+    out = dict(state)
+    out["edges"] = jnp.where(dead[:, None, None], False, state["edges"])
+    out["block_exists"] = jnp.where(dead[:, None], False, state["block_exists"])
+    out["block_seen"] = jnp.where(dead[None, :, None], False, state["block_seen"])
+    out["acks"] = jnp.where(dead[:, None, None], False, state["acks"])
+    out["cert_exists"] = jnp.where(dead[:, None], False, state["cert_exists"])
+    out["cert_seen"] = jnp.where(dead[None, :, None], False, state["cert_seen"])
+    out["slot_round"] = jnp.where(dead, state["slot_round"] + w,
+                                  state["slot_round"])
+    out["base_round"] = new_base
     return out
 
 

@@ -1,88 +1,197 @@
 """Tusk wave commit: leader election, support counting, causal
-linearization — as masked reductions over the DAG tensors.
+linearization — as scan-based masked reductions over the ring-buffered
+DAG tensors.
 
 Reference: BFT-CRDT/DAGConsensus/Consensus.cs — wave = 2 rounds (:48-67),
 seeded-random leader (:75-81), leader commits with >=2f+1 support in the
 next round (:83-135, :207-221), skipped leaders back-chained via DFS
 reachability (:97-109, :143-170), causal history ordered round-by-round
-with source-id tie-break (:172-205, :229-258).
+with source-id tie-break (:172-205, :229-258). Both ``Path`` (:160) and
+``TraverseDAG`` (:186) STOP at committed certificates — a committed
+block's history was already delivered, so traversal never descends
+through it. That no-descend rule is what makes the GC frontier sound:
+once a round is committed everywhere, nothing below it can ever be newly
+committed, so its slots can be recycled.
 
-Tensor re-design: the DFS-with-stack becomes bounded descending-round
-masked reachability over ``edges[W, N, N]``; the priority-queue ordering
-becomes a lexicographic sort key (commit_seq, round, source). Each commit
-*anchor* (a leader whose causal closure commits together) gets one
-monotonically increasing ``commit_seq`` value per node; the total order
-of blocks is then ascending (commit_seq, round, source) — byte-identical
-across honest nodes because anchors and closures are deterministic
-functions of the (converged) DAG.
+Tensor re-design: the DFS-with-stack becomes a bounded descending-round
+masked reachability (lax.fori_loop over the ring window); the per-wave
+Python loops of round 1 become a ``lax.scan`` whose carry is the commit
+cursor — trace size is O(1) in the window depth instead of O(N·W^3).
+Each view evaluates each wave exactly once, when its node_round first
+passes the wave's support round (the reference calls Commit(wave) once
+per even round, DAG.cs:793-803); waves skipped at evaluation time are
+revivable only through a later anchor's back-chain, exactly like the
+reference. Each commit *anchor* gets one monotonically increasing
+``commit_seq``; the total order of blocks is ascending
+(commit_seq, round, source) — byte-identical across honest nodes because
+anchors and closures are deterministic functions of the (converged) DAG.
 
 Deviation: the reference elects leader(wave) = new Random(wave).Next()%n
 (.NET PRNG); re-implementing a .NET PRNG is translation, not design, so
-leaders come from an integer mix (splitmix32) with the same properties —
-deterministic, seedable, uniform-ish. Tests parameterize on the leader
-function where the reference tests hardcode .NET draws.
+leaders come from a 32-bit integer mix (murmur3 finalizer) computable on
+device for unbounded wave numbers — deterministic, seedable, uniform-ish.
+Tests parameterize on the leader function where the reference tests
+hardcode .NET draws.
 """
 from __future__ import annotations
 
 from typing import Dict, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from janus_tpu.consensus.dag import DagConfig
 
 State = Dict[str, jnp.ndarray]
 
 
-def splitmix32(x: np.ndarray | int) -> np.ndarray:
-    """Deterministic 32-bit integer mix (public-domain splitmix constant
-    schedule) — the leader-election PRNG."""
-    z = (np.uint64(x) + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(0xFFFFFFFFFFFFFFFF)
-    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-    z &= np.uint64(0xFFFFFFFFFFFFFFFF)
-    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-    z &= np.uint64(0xFFFFFFFFFFFFFFFF)
-    return np.uint32((z ^ (z >> np.uint64(31))) & np.uint64(0xFFFFFFFF))
+def _mix32(x):
+    """murmur3 finalizer on uint32 (public-domain constant schedule) —
+    the leader-election mix, identical on device and host."""
+    x = x ^ (x >> 16)
+    x = x * 0x85EBCA6B
+    x = x ^ (x >> 13)
+    x = x * 0xC2B2AE35
+    x = x ^ (x >> 16)
+    return x
+
+
+def leader_of(cfg: DagConfig, wave, seed: int = 0):
+    """Leader node id for a (possibly traced, unbounded) wave number."""
+    w = jnp.asarray(wave).astype(jnp.uint32)
+    h = _mix32(w * jnp.uint32(2654435761) + jnp.uint32(seed * 0x9E3779B9 + 1))
+    return (h % jnp.uint32(cfg.num_nodes)).astype(jnp.int32)
 
 
 def leaders(cfg: DagConfig, seed: int = 0) -> np.ndarray:
-    """int32[W//2]: leader node id per wave."""
-    waves = np.arange(cfg.num_rounds // 2, dtype=np.uint64)
-    return (splitmix32(waves + np.uint64(seed) * np.uint64(0x51D)).astype(np.int64)
-            % cfg.num_nodes).astype(np.int32)
+    """int32[W//2]: leader per wave for the first window (host-side
+    convenience; same mix as ``leader_of``)."""
+    waves = np.arange(cfg.num_rounds // 2, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        h = _mix32(waves * np.uint32(2654435761)
+                   + np.uint32((seed * 0x9E3779B9 + 1) & 0xFFFFFFFF))
+    return (h % np.uint32(cfg.num_nodes)).astype(np.int32)
 
 
 def init_commit(cfg: DagConfig) -> State:
     n, w = cfg.num_nodes, cfg.num_rounds
     return {
-        "committed": jnp.zeros((n, w, n), bool),      # per node view
+        "committed": jnp.zeros((n, w, n), bool),       # per node view, slot-indexed
         "commit_seq": jnp.full((n, w, n), -1, jnp.int32),
-        "last_wave": jnp.full((n,), -1, jnp.int32),
+        "last_wave": jnp.full((n,), -1, jnp.int32),    # last committed anchor
+        "eval_wave": jnp.full((n,), -1, jnp.int32),    # last evaluated wave
         "commit_counter": jnp.zeros((n,), jnp.int32),
+        # snapshot of the DAG's slot->round map at the last commit call,
+        # so host-side ordering can translate slots to logical rounds
+        "slot_round": jnp.arange(w, dtype=jnp.int32),
     }
 
 
-def _reach_from(cfg: DagConfig, edges, seen, anchor_round: int, src) -> jnp.ndarray:
-    """bool[W, N] blocks reachable from (anchor_round, src) following
-    prev-certificate edges downward, restricted to blocks in ``seen``.
-    anchor_round is static; src is a traced scalar."""
+def _closure(cfg: DagConfig, edges, certs_v, com, base, anchor_r, src):
+    """bool[W, N] (slot-indexed): uncommitted certificates reachable from
+    (anchor_r, src) following prev-certificate edges downward, through
+    held uncommitted certs only — committed certs stop the traversal
+    (TraverseDAG/Path skip rule, Consensus.cs:160,186). ``anchor_r`` and
+    ``src`` may be traced."""
     w, n = cfg.num_rounds, cfg.num_nodes
-    reach = jnp.zeros((w, n), bool).at[anchor_round].set(
-        jnp.arange(n) == src
-    )
-    reach = reach & seen
-    for r in range(anchor_round, 0, -1):
-        prev = jnp.any(reach[r][:, None] & edges[r], axis=0)  # [N]
-        reach = reach.at[r - 1].max(prev & seen[r - 1])
-    return reach
+    anchor_r = jnp.asarray(anchor_r, jnp.int32)
+    s0 = anchor_r % w
+    start = (jnp.arange(n) == src) & certs_v[s0] & ~com[s0]
+    reach = jnp.zeros((w, n), bool).at[s0].set(start)
+
+    def body(j, reach):
+        r = anchor_r - j
+        s = r % w
+        sp = (r - 1) % w
+        frontier = reach[s]  # [N]
+        prev = jnp.any(frontier[:, None] & edges[s], axis=0)  # [N]
+        grow = prev & certs_v[sp] & ~com[sp] & (r >= 1) & (r - 1 >= base)
+        return reach.at[sp].max(grow)
+
+    return lax.fori_loop(0, w - 1, body, reach)
 
 
-def _wave_support(cfg: DagConfig, edges, block_seen_v, wave: int, leader) -> jnp.ndarray:
-    """Support for leader's round-2w block from seen round-(2w+1) blocks
-    (CheckEnoughSupport, Consensus.cs:207-221)."""
-    r_sup = 2 * wave + 1
-    votes = block_seen_v[r_sup] & edges[r_sup, :, leader]
+def _support(cfg: DagConfig, edges, seen_v, wv, leader):
+    """>=2f+1 seen round-(2wv+1) blocks reference the leader's round-2wv
+    certificate (CheckEnoughSupport, Consensus.cs:207-221)."""
+    s_sup = (2 * wv + 1) % cfg.num_rounds
+    votes = seen_v[s_sup] & edges[s_sup, :, leader]
     return jnp.sum(votes) >= cfg.quorum
+
+
+def _commit_one_view(cfg: DagConfig, edges, base, seed: int, steps: int,
+                     seen_v, certs_v, nr_v, com, seq, lw, ew, cnt):
+    """Process up to ``steps`` newly-complete waves for one view."""
+    w, n = cfg.num_rounds, cfg.num_nodes
+    lb = max(1, w // 2)  # back-chain window (waves live in the ring)
+
+    def wave_step(carry, _):
+        com, seq, lw, ew, cnt = carry
+        wv = ew + 1
+        complete = nr_v > 2 * wv + 1
+        l = leader_of(cfg, wv, seed)
+        s_anchor = (2 * wv) % w
+        anchor_ok = (
+            complete
+            & certs_v[s_anchor, l]
+            & _support(cfg, edges, seen_v, wv, l)
+        )
+        com0 = com  # committed state before this anchor's batch
+
+        # -- back-chain discovery, newest-to-oldest (Consensus.cs:97-109):
+        # walk waves wv-1 .. lw+1; a skipped leader is chained iff its
+        # cert is held, it is uncommitted, and it is reachable from the
+        # current chain head; the head then moves to it.
+        def disc_step(dcarry, j):
+            head_r, head_src, alive = dcarry
+            wp = wv - 1 - j
+            lp = leader_of(cfg, wp, seed)
+            sp = (2 * wp) % w
+            in_range = (wp > lw) & (2 * wp >= base)
+            cand_ok = alive & in_range & certs_v[sp, lp] & ~com0[sp, lp]
+            head_cl = _closure(cfg, edges, certs_v, com0, base, head_r, head_src)
+            chained = cand_ok & head_cl[sp, lp]
+            head_r = jnp.where(chained, 2 * wp, head_r)
+            head_src = jnp.where(chained, lp, head_src)
+            return (head_r, head_src, alive), (chained, lp, wp)
+
+        (_, _, _), (chained, lps, wps) = lax.scan(
+            disc_step, (2 * wv, l, anchor_ok), jnp.arange(lb)
+        )
+
+        # -- commit oldest-first (leaderStack pop order): each chained
+        # leader anchors its own not-yet-committed closure with its own
+        # sequence number, then the wave anchor commits its closure.
+        def com_step(ccarry, x):
+            com, seq, cnt = ccarry
+            ch, lp, wp = x
+            cl = _closure(cfg, edges, certs_v, com, base, 2 * wp, lp)
+            new = cl & ch
+            com = com | new
+            seq = jnp.where(new, cnt, seq)
+            cnt = cnt + ch.astype(jnp.int32)
+            return (com, seq, cnt), None
+
+        (com, seq, cnt), _ = lax.scan(
+            com_step, (com, seq, cnt),
+            (chained[::-1], lps[::-1], wps[::-1]),
+        )
+        cl = _closure(cfg, edges, certs_v, com, base, 2 * wv, l)
+        new = cl & anchor_ok
+        com = com | new
+        seq = jnp.where(new, cnt, seq)
+        cnt = cnt + anchor_ok.astype(jnp.int32)
+
+        lw = jnp.where(anchor_ok, wv, lw)
+        ew = jnp.where(complete, wv, ew)
+        return (com, seq, lw, ew, cnt), None
+
+    (com, seq, lw, ew, cnt), _ = lax.scan(
+        wave_step, (com, seq, lw, ew, cnt), None, length=steps
+    )
+    return com, seq, lw, ew, cnt
 
 
 def commit_view(
@@ -91,114 +200,74 @@ def commit_view(
     cstate: State,
     node: int | None = None,
     seed: int = 0,
-    lookback: int | None = None,
+    steps: int | None = None,
 ) -> State:
-    """Run the Tusk commit rule for every node's view (or one node).
+    """Run the Tusk commit rule for every node's view: evaluate up to
+    ``steps`` (default: a full window of waves) newly-complete waves per
+    view, committing anchors with >=2f+1 support plus their back-chained
+    skipped leaders and causal closures. ``node`` is accepted for
+    API compatibility and ignored (all views are processed — the
+    per-view work is vmapped, so there is nothing to save)."""
+    del node
+    n_steps = steps if steps is not None else max(1, cfg.num_rounds // 2)
 
-    For each complete wave past the node's last committed wave, in
-    ascending order: if the leader certificate is held and the leader has
-    >=2f+1 support, the leader anchors a commit; leaders of earlier
-    skipped waves that are causally reachable from the anchor commit
-    first (back-chaining), each with its own sequence number; every
-    anchor commits its not-yet-committed causal closure.
-    """
-    ldrs = leaders(cfg, seed)
-    nodes = range(cfg.num_nodes) if node is None else [node]
-    committed = cstate["committed"]
-    commit_seq = cstate["commit_seq"]
-    last_wave = cstate["last_wave"]
-    counter = cstate["commit_counter"]
+    def one_view(seen_v, certs_v, nr_v, com, seq, lw, ew, cnt):
+        return _commit_one_view(
+            cfg, dag_state["edges"], dag_state["base_round"], seed, n_steps,
+            seen_v, certs_v, nr_v, com, seq, lw, ew, cnt,
+        )
 
-    for v in nodes:
-        com_v = committed[v]
-        seq_v = commit_seq[v]
-        lw = last_wave[v]
-        cnt = counter[v]
-        seen_v = dag_state["block_seen"][v]
-        certs_v = dag_state["cert_seen"][v]
-        max_wave = cfg.num_rounds // 2 - 1
-        for wv in range(0, max_wave + 1):
-            if 2 * wv + 1 >= cfg.num_rounds:
-                break
-            l = int(ldrs[wv])
-            # node must have progressed past the support round
-            complete = dag_state["node_round"][v] > 2 * wv + 1
-            anchor_ok = (
-                complete
-                & (wv > lw)
-                & certs_v[2 * wv, l]
-                & _wave_support(cfg, dag_state["edges"], seen_v, wv, l)
-            )
-            # anchor reachability (full closure from this leader)
-            reach = _reach_from(cfg, dag_state["edges"], seen_v, 2 * wv, l)
-
-            # Back-chain discovery, newest-to-oldest: walk earlier skipped
-            # leaders; one is chained in iff reachable from the current
-            # chain head (which then moves to it); an already-committed
-            # leader ends the walk (Consensus.cs:97-109).
-            # lookback bounds the back-chain window (and therefore trace
-            # size): leaders skipped for more than `lookback` waves are
-            # abandoned, the tensor analog of the reference's GC of old
-            # committed rounds (DAG.cs:946-965)
-            lo = 0 if lookback is None else max(0, wv - lookback)
-            head_reach = reach
-            chain_alive = anchor_ok
-            sub_oks: dict = {}
-            sub_closures: dict = {}
-            for wp in range(wv - 1, lo - 1, -1):
-                lp = int(ldrs[wp])
-                closure_p = _reach_from(cfg, dag_state["edges"], seen_v, 2 * wp, lp)
-                already = com_v[2 * wp, lp]
-                sub_ok = chain_alive & (wp > lw) & head_reach[2 * wp, lp] & ~already
-                sub_oks[wp] = sub_ok
-                sub_closures[wp] = closure_p
-                head_reach = jnp.where(sub_ok, closure_p, head_reach)
-                chain_alive = chain_alive & ~already
-
-            # Commit oldest-first: each chained leader anchors its own
-            # not-yet-committed closure with its own sequence number.
-            for wp in range(lo, wv):
-                sub_ok = sub_oks[wp]
-                sub_new = sub_closures[wp] & ~com_v
-                com_v = jnp.where(sub_ok, com_v | sub_new, com_v)
-                seq_v = jnp.where(sub_ok & sub_new, cnt, seq_v)
-                cnt = cnt + sub_ok.astype(jnp.int32)
-            new = reach & ~com_v
-            com_v = jnp.where(anchor_ok, com_v | new, com_v)
-            seq_v = jnp.where(anchor_ok & new, cnt, seq_v)
-            cnt = cnt + anchor_ok.astype(jnp.int32)
-            lw = jnp.where(anchor_ok, wv, lw)
-        committed = committed.at[v].set(com_v)
-        commit_seq = commit_seq.at[v].set(seq_v)
-        last_wave = last_wave.at[v].set(lw)
-        counter = counter.at[v].set(cnt)
-
+    com, seq, lw, ew, cnt = jax.vmap(one_view)(
+        dag_state["block_seen"], dag_state["cert_seen"],
+        dag_state["node_round"], cstate["committed"], cstate["commit_seq"],
+        cstate["last_wave"], cstate["eval_wave"], cstate["commit_counter"],
+    )
     return {
-        "committed": committed,
-        "commit_seq": commit_seq,
-        "last_wave": last_wave,
-        "commit_counter": counter,
+        "committed": com,
+        "commit_seq": seq,
+        "last_wave": lw,
+        "eval_wave": ew,
+        "commit_counter": cnt,
+        "slot_round": dag_state["slot_round"],
     }
 
 
+def recycle_commit(cfg: DagConfig, cstate: State, new_base) -> State:
+    """Clear commit rows for slots below the new GC frontier (pairs with
+    dag.recycle; callers must have drained/logged those commits)."""
+    dead = cstate["slot_round"] < jnp.asarray(new_base, jnp.int32)  # [W]
+    out = dict(cstate)
+    out["committed"] = jnp.where(dead[None, :, None], False, cstate["committed"])
+    out["commit_seq"] = jnp.where(dead[None, :, None], -1, cstate["commit_seq"])
+    out["slot_round"] = jnp.where(dead, cstate["slot_round"] + cfg.num_rounds,
+                                  cstate["slot_round"])
+    return out
+
+
 def ordered_blocks(cfg: DagConfig, cstate: State, node: int) -> list[Tuple[int, int]]:
-    """Host-side: the node's committed blocks in total order —
-    ascending (commit_seq, round, source). The analog of the reference's
-    ordered ``List<List<UpdateMessage>>`` output (Consensus.cs:229-258)."""
+    """Host-side: the node's committed blocks in total order — ascending
+    (commit_seq, logical round, source). The analog of the reference's
+    ordered ``List<List<UpdateMessage>>`` output (Consensus.cs:229-258).
+    Covers only the live window; SafeKV keeps the full history in its
+    host-side commit log."""
     com = np.asarray(cstate["committed"][node])
     seq = np.asarray(cstate["commit_seq"][node])
-    rr, ss = np.nonzero(com)
-    order = np.lexsort((ss, rr, seq[rr, ss]))
+    rounds = np.asarray(cstate["slot_round"])
+    ss_slot, ss = np.nonzero(com)
+    rr = rounds[ss_slot]
+    order = np.lexsort((ss, rr, seq[ss_slot, ss]))
     return [(int(rr[i]), int(ss[i])) for i in order]
 
 
-def order_key(cfg: DagConfig, cstate: State) -> jnp.ndarray:
-    """Device-side total-order key per (node, round, source):
-    key = seq * W * N + round * N + source, or INT32_MAX if uncommitted.
-    Sorting blocks by this key yields the commit order — used by the
-    stable-state apply pipeline."""
+def order_key(cfg: DagConfig, cstate: State, base=None) -> jnp.ndarray:
+    """Device-side total-order key per (node, slot, source):
+    key = seq * W * N + (round - base) * N + source, INT32_MAX where
+    uncommitted. (round - base) < W for live rounds, so the key orders
+    identically to (seq, logical round, source); seq must stay below
+    2^31 / (W*N) — far beyond any emulation length."""
     w, n = cfg.num_rounds, cfg.num_nodes
-    rounds = jnp.arange(w, dtype=jnp.int32)[None, :, None]
+    b = cstate["slot_round"].min() if base is None else base
+    rel = (cstate["slot_round"] - b)[None, :, None]
     srcs = jnp.arange(n, dtype=jnp.int32)[None, None, :]
-    key = cstate["commit_seq"] * (w * n) + rounds * n + srcs
+    key = cstate["commit_seq"] * (w * n) + rel * n + srcs
     return jnp.where(cstate["committed"], key, jnp.iinfo(jnp.int32).max)

@@ -84,15 +84,49 @@ class CRDTTypeSpec:
     queries: Dict[str, Callable]
     op_codes: Dict[str, int]  # wire opCode letter -> op id (CmdParser.cs:12-16)
     # Effect capture for replicated replay: extra per-op payload fields
-    # (name -> dim-name resolved against the type's init dims, giving the
-    # trailing width) filled by ``prepare_ops(origin_state, ops) -> ops``
-    # at submit time. Needed by types whose ops read their observed state
-    # (OR-Set remove tombstones *observed* tags): capturing the
-    # observation makes replay commutative across delivery groupings,
+    # (name -> trailing width, either an int or a dim-name resolved
+    # against the type's init dims) filled by
+    # ``prepare_ops(origin_state, ops) -> ops`` at submit time. Needed by
+    # types whose ops read their observed state (OR-Set remove tombstones
+    # *observed* tags; gated removes; MVRegister write clocks): capturing
+    # the observation makes replay commutative across delivery groupings,
     # the tensor analog of the reference shipping full state snapshots
     # instead of operations (ReplicationManager.cs:347-357).
-    op_extras: Dict[str, str] = dataclasses.field(default_factory=dict)
+    op_extras: Dict[str, str | int] = dataclasses.field(default_factory=dict)
     prepare_ops: Callable[[Any, OpBatch], OpBatch] | None = None
+    # Replay safety: True iff apply_ops is a pure function of (state, op
+    # data) whose replicated replay converges under any certify/commit
+    # batching — either because apply is order-insensitive with no reads
+    # of uncaptured local state (PN-Counter), or because prepare_ops
+    # captures every observation. SafeKV refuses specs that are neither
+    # (silent divergence otherwise — round-1 advisor finding).
+    replay_safe: bool = False
+
+
+def capture_and_apply(spec: CRDTTypeSpec, state: Any, ops: OpBatch):
+    """Origin-side submit: sequentially capture then apply each op, so an
+    op's effect capture observes the state produced by *earlier ops in
+    the same batch* (the reference serializes client ops per object —
+    PNCounterCommand.cs:29 lock — so `[add v, use v]` in one batch must
+    work). Returns ``(post_state, prepared_ops)``; the prepared ops are
+    what ships in the consensus payload and what every replica (including
+    the origin, whose post_state this already is) replays.
+
+    Types without prepare_ops apply as one batch (their apply reads no
+    local state, so per-op interleaving is irrelevant)."""
+    from jax import lax as _lax
+
+    if spec.prepare_ops is None:
+        return spec.apply_ops(state, ops), ops
+
+    def step(st, op):
+        one = {f: v[None] for f, v in op.items()}
+        prepared = spec.prepare_ops(st, one)
+        st2 = spec.apply_ops(st, prepared)
+        return st2, {f: v[0] for f, v in prepared.items()}
+
+    state2, prepared = _lax.scan(step, state, ops)
+    return state2, prepared
 
 
 _REGISTRY: Dict[str, CRDTTypeSpec] = {}

@@ -48,29 +48,64 @@ def init(num_keys: int, v_capacity: int, e_capacity: int) -> State:
     }
 
 
-def _vertex_live(row):
-    return row["v_valid"] & ~row["v_removed"]
+def _op_gates(rows, op_code, a0, a1):
+    """Precondition gates against given key rows: rv needs a live vertex
+    with no live incident edge; ae needs both endpoints live; re needs a
+    live edge (TPTPGraph.cs:78-133). Batched over leading axes."""
+    v_live = rows["v_valid"] & ~rows["v_removed"]
+    e_live = rows["e_valid"] & ~rows["e_removed"]
+    a0b = jnp.asarray(a0)[..., None]
+    a1b = jnp.asarray(a1)[..., None]
+
+    def has_vertex(xb):
+        return jnp.any(v_live & (rows["v"] == xb), axis=-1)
+
+    incident = jnp.any(
+        e_live & ((rows["src"] == a0b) | (rows["dst"] == a0b)), axis=-1)
+    rv_ok = has_vertex(a0b) & ~incident
+    ae_ok = has_vertex(a0b) & has_vertex(a1b)
+    e_hit = rows["e_valid"] & (rows["src"] == a0b) & (rows["dst"] == a1b)
+    re_ok = jnp.any(e_hit & ~rows["e_removed"], axis=-1)
+    return jnp.where(
+        op_code == OP_REMOVE_VERTEX, rv_ok,
+        jnp.where(op_code == OP_ADD_EDGE, ae_ok,
+                  jnp.where(op_code == OP_REMOVE_EDGE, re_ok, True)),
+    )
 
 
-def _edge_live(row):
-    return row["e_valid"] & ~row["e_removed"]
+def prepare_ops(state: State, ops: base.OpBatch) -> base.OpBatch:
+    """Effect capture at the origin: each op's precondition gate is
+    evaluated against the given state and shipped as ``ok[B, 1]``;
+    replay applies gated ops unconditionally (removes as sticky
+    tombstone upserts), so replicas converge regardless of the order in
+    which certified blocks deliver their updates. The runtime captures
+    per-op through ``base.capture_and_apply``, so gates observe earlier
+    ops of the same batch ([add_vertex v, add_edge v->w] works)."""
+    rows = {f: state[f][ops["key"]] for f in state}
+    ok = _op_gates(rows, ops["op"], ops["a0"], ops["a1"])
+    return {**ops, "ok": ok[:, None].astype(jnp.int32)}
 
 
 def apply_ops(state: State, ops: base.OpBatch) -> State:
     """av: a0=v; rv: a0=v (requires live + no live incident edge);
     ae: a0=src, a1=dst (requires both endpoints live);
-    re: a0=src, a1=dst (requires edge live)."""
+    re: a0=src, a1=dst (requires edge live).
+
+    With a captured ``ok`` flag (effect capture) the gates were decided
+    at the origin and removes upsert sticky tombstone records (insert if
+    absent, so late-arriving adds cannot resurrect); without capture,
+    gates read the local state at apply time."""
+    has_capture = "ok" in ops
 
     def step(st, op):
         k = op["key"]
         row = {f: st[f][k] for f in st}
         code = op["op"]
 
-        v_live = _vertex_live(row)
-        e_live = _edge_live(row)
-
-        def has_vertex(x):
-            return jnp.any(v_live & (row["v"] == x))
+        if has_capture:
+            gate = op["ok"][0] != 0
+        else:
+            gate = _op_gates(row, code, op["a0"], op["a1"])
 
         # -- add vertex ----------------------------------------------------
         vrow = {"elem": row["v"], "removed": row["v_removed"], "valid": row["v_valid"]}
@@ -80,14 +115,21 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
             enabled=code == OP_ADD_VERTEX,
         )
 
-        # -- remove vertex: live, and no live edge touches it --------------
-        incident = jnp.any(e_live & ((row["src"] == op["a0"]) | (row["dst"] == op["a0"])))
-        rv_ok = (code == OP_REMOVE_VERTEX) & has_vertex(op["a0"]) & ~incident
-        v_hit = row["v_valid"] & (row["v"] == op["a0"])
-        v_removed = v_added["removed"] | jnp.where(rv_ok, v_hit, False)
+        # -- remove vertex -------------------------------------------------
+        rv_ok = (code == OP_REMOVE_VERTEX) & gate
+        if has_capture:
+            v_done = row_upsert(
+                v_added, ("elem",), (op["a0"],), {"removed": jnp.bool_(True)},
+                lambda old, new: {"removed": jnp.bool_(True)},
+                enabled=rv_ok,
+            )
+        else:
+            v_hit = row["v_valid"] & (row["v"] == op["a0"])
+            v_done = dict(v_added)
+            v_done["removed"] = v_added["removed"] | jnp.where(rv_ok, v_hit, False)
 
-        # -- add edge: both endpoints live ---------------------------------
-        ae_ok = (code == OP_ADD_EDGE) & has_vertex(op["a0"]) & has_vertex(op["a1"])
+        # -- add edge ------------------------------------------------------
+        ae_ok = (code == OP_ADD_EDGE) & gate
         erow = {"src": row["src"], "dst": row["dst"],
                 "removed": row["e_removed"], "valid": row["e_valid"]}
         e_added = row_upsert(
@@ -96,15 +138,26 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
             enabled=ae_ok,
         )
 
-        # -- remove edge: live ---------------------------------------------
-        e_hit = row["e_valid"] & (row["src"] == op["a0"]) & (row["dst"] == op["a1"])
-        re_ok = (code == OP_REMOVE_EDGE) & jnp.any(e_hit & ~row["e_removed"])
-        e_removed = e_added["removed"] | jnp.where(re_ok, e_hit, False)
+        # -- remove edge ---------------------------------------------------
+        re_ok = (code == OP_REMOVE_EDGE) & gate
+        if has_capture:
+            e_done = row_upsert(
+                e_added, ("src", "dst"), (op["a0"], op["a1"]),
+                {"removed": jnp.bool_(True)},
+                lambda old, new: {"removed": jnp.bool_(True)},
+                enabled=re_ok,
+            )
+        else:
+            e_hit = (row["e_valid"] & (row["src"] == op["a0"])
+                     & (row["dst"] == op["a1"]))
+            e_done = dict(e_added)
+            e_done["removed"] = e_added["removed"] | jnp.where(re_ok, e_hit, False)
 
         out = {
-            "v": v_added["elem"], "v_removed": v_removed, "v_valid": v_added["valid"],
-            "src": e_added["src"], "dst": e_added["dst"],
-            "e_removed": e_removed, "e_valid": e_added["valid"],
+            "v": v_done["elem"], "v_removed": v_done["removed"],
+            "v_valid": v_done["valid"],
+            "src": e_done["src"], "dst": e_done["dst"],
+            "e_removed": e_done["removed"], "e_valid": e_done["valid"],
         }
         st = {f: st[f].at[k].set(out[f]) for f in st}
         return st, None
@@ -177,5 +230,7 @@ SPEC = base.register_type(
         queries={"vertex_count": vertex_count, "edge_count": edge_count},
         op_codes={"av": OP_ADD_VERTEX, "rv": OP_REMOVE_VERTEX,
                   "ae": OP_ADD_EDGE, "re": OP_REMOVE_EDGE},
+        op_extras={"ok": 1},
+        prepare_ops=prepare_ops,
     )
 )

@@ -54,10 +54,32 @@ def _slot_live(valid, add_hi, add_lo, rm_hi, rm_lo):
     return valid & has_add & ts_after(add_hi, add_lo, rm_hi, rm_lo)
 
 
+def prepare_ops(state: State, ops: base.OpBatch) -> base.OpBatch:
+    """Effect capture at the origin: a remove records whether its element
+    was contained in the origin's pre-batch state (``ok[B, 1]``), so
+    replay applies the stamp unconditionally — the membership gate
+    (LWWSet.cs:168-191 only stamps removes of contained elements) was
+    decided once at the origin. Both polarities then fold by timestamp
+    max, which is order-insensitive."""
+    rows = {f: state[f][ops["key"]] for f in
+            ("valid", "elem", "add_hi", "add_lo", "rm_hi", "rm_lo")}
+    hit = rows["valid"] & (rows["elem"] == ops["a0"][:, None])
+    contained = jnp.any(
+        _slot_live(hit, rows["add_hi"], rows["add_lo"],
+                   rows["rm_hi"], rows["rm_lo"]),
+        axis=-1,
+    )
+    ok = jnp.where(ops["op"] == OP_REMOVE, contained, True)
+    return {**ops, "ok": ok[:, None].astype(jnp.int32)}
+
+
 def apply_ops(state: State, ops: base.OpBatch) -> State:
     """add: a0=elem, a1=ts_hi, a2=ts_lo — upsert add stamp (max fold).
-    remove: same args — stamps only if the element is currently contained,
-    matching the reference's effect-gated Remove."""
+    remove: same args — with a captured ``ok`` flag the stamp applies
+    unconditionally (gate decided at origin); without capture, stamps only
+    if the element is currently contained locally, matching the
+    reference's effect-gated Remove."""
+    has_capture = "ok" in ops
 
     def step(st, op):
         k = op["key"]
@@ -66,10 +88,14 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
         is_add = en & (op["op"] == OP_ADD)
         is_rm = en & (op["op"] == OP_REMOVE)
 
-        hit = row["valid"] & (row["elem"] == op["a0"])
-        contained = jnp.any(
-            _slot_live(hit, row["add_hi"], row["add_lo"], row["rm_hi"], row["rm_lo"])
-        )
+        if has_capture:
+            contained = op["ok"][0] != 0
+        else:
+            hit = row["valid"] & (row["elem"] == op["a0"])
+            contained = jnp.any(
+                _slot_live(hit, row["add_hi"], row["add_lo"],
+                           row["rm_hi"], row["rm_lo"])
+            )
 
         def upsert(payload, enabled):
             return row_upsert(
@@ -129,5 +155,7 @@ SPEC = base.register_type(
         merge=merge,
         queries={"contains": contains, "live_count": live_count},
         op_codes={"a": OP_ADD, "r": OP_REMOVE},
+        op_extras={"ok": 1},
+        prepare_ops=prepare_ops,
     )
 )

@@ -46,15 +46,63 @@ def init(num_keys: int, num_writers: int, capacity: int) -> State:
     }
 
 
+def prepare_ops(state: State, ops: base.OpBatch) -> base.OpBatch:
+    """Effect capture at the origin: each write's vector clock is computed
+    against the given state — max over live value clocks with the
+    writer's own lane bumped — and shipped as ``wclock[B, W]``. The
+    runtime captures per-op through ``base.capture_and_apply``, so a
+    later write in the same batch observes (and therefore dominates) an
+    earlier same-key write's clock."""
+    num_writers = state["clock"].shape[-1]
+    live = state["valid"][ops["key"]][..., None]          # [B, V, 1]
+    observed = jnp.max(
+        jnp.where(live, state["clock"][ops["key"]], 0), axis=-2
+    )                                                     # [B, W]
+    is_write = ops["op"] == OP_WRITE
+    lane = jnp.arange(num_writers)[None, :] == ops["writer"][:, None]
+    wclock = observed + jnp.where(lane, 1, 0)
+    return {**ops, "wclock": jnp.where(is_write[:, None], wclock, 0)}
+
+
+def _row_join(row, val, clock, enabled):
+    """Join one key row with a singleton (val, clock) write — the same
+    frontier rule as ``merge``, reusing merge_with_stats with a
+    capacity-1 singleton state."""
+    single = {
+        "val": jnp.asarray(val)[None],
+        "valid": jnp.asarray(enabled)[None],
+        "clock": clock[None, :],
+    }
+    joined, _ = merge_with_stats(row, single)
+    return joined
+
+
 def apply_ops(state: State, ops: base.OpBatch) -> State:
-    """write: a0=value id, writer=writer lane — the write observes every
-    live value (clock = max over live slots, own lane + 1) and replaces the
-    value set with the single written value."""
+    """write: a0=value id, writer=writer lane.
+
+    With a captured ``wclock`` (effect capture), apply = lattice join with
+    the singleton (value, clock) — commutative, so replicated replay
+    converges under any delivery order; the written value dominates
+    exactly what its origin observed. Without capture (host-direct use),
+    the write observes every locally-live value (clock = max over live
+    slots, own lane + 1) and replaces the value set — the reference's
+    Write semantics (MVRegister.cs:108-114)."""
+    has_capture = "wclock" in ops
 
     def step(st, op):
         k = op["key"]
         en = op["op"] == OP_WRITE
         vcap, w = st["clock"].shape[-2:]
+        if has_capture:
+            row = {"val": st["val"][k], "valid": st["valid"][k],
+                   "clock": st["clock"][k]}
+            joined = _row_join(row, op["a0"], op["wclock"], en)
+            st = {
+                "val": st["val"].at[k].set(jnp.where(en, joined["val"], row["val"])),
+                "valid": st["valid"].at[k].set(jnp.where(en, joined["valid"], row["valid"])),
+                "clock": st["clock"].at[k].set(jnp.where(en, joined["clock"], row["clock"])),
+            }
+            return st, None
         live = st["valid"][k][:, None]  # [V, 1]
         observed = jnp.max(jnp.where(live, st["clock"][k], 0), axis=0)  # [W]
         new_clock = observed.at[op["writer"]].add(1)
@@ -151,5 +199,7 @@ SPEC = base.register_type(
         merge=merge,
         queries={"num_values": num_values},
         op_codes={"w": OP_WRITE},
+        op_extras={"wclock": "num_writers"},
+        prepare_ops=prepare_ops,
     )
 )

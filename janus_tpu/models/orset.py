@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from janus_tpu.models import base
-from janus_tpu.ops import SENTINEL, make_slots, row_insert, slot_union
+from janus_tpu.ops import SENTINEL, make_slots, row_upsert, slot_union
 
 OP_ADD = 1    # reference opId 1 = Add (ORSetWrapper.cs:30-47)
 OP_REMOVE = 2
@@ -57,17 +57,22 @@ def _combine(p, q):
 
 
 def prepare_ops(state: State, ops: base.OpBatch) -> base.OpBatch:
-    """Effect capture at the origin: remove/clear ops record the
-    per-minting-replica tag-counter frontier they observe, so replicated
-    replay tombstones exactly the observed tags no matter how delivery
-    batches ops (the reference gets this for free by shipping state
-    snapshots; op replay without capture is not commutative).
+    """Effect capture at the origin: remove/clear ops record the exact
+    observed tags they cover, so replicated replay tombstones exactly
+    those tags no matter how delivery orders or batches ops. This is the
+    tensor form of the reference's remove-set semantics — Remove copies
+    the observed add-tags into the remove set and ships them
+    (ORSet.cs:161-186); op replay without the captured set is not
+    commutative (an observed add arriving after the remove at another
+    node would resurrect).
 
-    frontier[b, p] = highest tag_ctr minted by replica p among the
-    observed (valid) tags the op covers — elem-matched for remove, all
-    tags for clear; 0 = nothing observed (real counters start at 1).
+    Captured fields (each [B, C]): ``rm_rep``/``rm_ctr`` — the observed
+    tag ids (SENTINEL in unused lanes), ``rm_elem`` — the tag's element.
+    Selection is elem-matched for remove, every valid tag for clear,
+    against the given state. The runtime captures per-op through
+    ``base.capture_and_apply``, so a remove in the same batch as an
+    earlier add DOES observe (and tombstone) that add's tag.
     """
-    num_writers = ops["frontier"].shape[-1]
     rows_valid = state["valid"][ops["key"]]    # [B, C]
     rows_elem = state["elem"][ops["key"]]
     rows_rep = state["tag_rep"][ops["key"]]
@@ -76,11 +81,12 @@ def prepare_ops(state: State, ops: base.OpBatch) -> base.OpBatch:
     is_cl = ops["op"] == OP_CLEAR
     sel = rows_valid & jnp.where(is_rm[:, None], rows_elem == ops["a0"][:, None], True)
     sel = sel & (is_rm | is_cl)[:, None]
-    onehot = rows_rep[..., None] == jnp.arange(num_writers)[None, None, :]
-    frontier = jnp.max(
-        jnp.where(sel[..., None] & onehot, rows_ctr[..., None], 0), axis=1
-    ).astype(jnp.int32)
-    return {**ops, "frontier": frontier}
+    return {
+        **ops,
+        "rm_rep": jnp.where(sel, rows_rep, SENTINEL),
+        "rm_ctr": jnp.where(sel, rows_ctr, SENTINEL),
+        "rm_elem": jnp.where(sel, rows_elem, 0),
+    }
 
 
 def apply_ops(state: State, ops: base.OpBatch) -> State:
@@ -89,38 +95,64 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
     reference's per-object lock serialization (ORSetCommand.cs).
 
     add:    a0=elem, a1=tag_rep, a2=tag_ctr (host mints unique tags)
-    remove: a0=elem  (tombstones observed tags of elem; with a prepared
-            ``frontier`` field, "observed" is the captured frontier —
-            tags (p, c) with c <= frontier[p] — otherwise whatever is
-            locally present at apply time)
-    clear:  tombstones every observed tag (same frontier rule)
+    remove: a0=elem. With prepared ``rm_rep``/``rm_ctr``/``rm_elem``
+            fields (effect capture), the op union-inserts its captured
+            tags as tombstoned slots — a captured tag not yet locally
+            present lands already-dead, so a later-arriving add of that
+            tag cannot resurrect it (the commutativity fix for replay
+            under out-of-order certificate delivery). Without capture
+            (host-direct use), tombstones whatever matching tags are
+            locally present at apply time.
+    clear:  same, over every observed tag.
     """
-    has_frontier = "frontier" in ops
+    has_capture = "rm_rep" in ops
 
     def step(st, op):
         k = op["key"]
         row = {f: st[f][k] for f in st}
         en = op["op"] != base.OP_NOOP
+        is_tomb = en & ((op["op"] == OP_REMOVE) | (op["op"] == OP_CLEAR))
 
-        added = row_insert(
+        # Upsert, not insert: the tag may already be present as a
+        # tombstone record (a captured remove that arrived first) — the
+        # removed bit is sticky, so a late add lands dead instead of
+        # duplicating the key (idempotent re-delivery also folds here).
+        added = row_upsert(
             row,
-            {"tag_rep": op["a1"], "tag_ctr": op["a2"], "elem": op["a0"],
-             "removed": jnp.bool_(False)},
+            KEY_FIELDS,
+            (op["a1"], op["a2"]),
+            {"elem": op["a0"], "removed": jnp.bool_(False)},
+            combine_existing=lambda old, new: {
+                "elem": new["elem"], "removed": old["removed"]
+            },
             enabled=en & (op["op"] == OP_ADD),
         )
-        if has_frontier:
-            within = row["tag_ctr"] <= op["frontier"][row["tag_rep"]]
+        if has_capture:
+            # tombstone-record union: captured tags fold into existing
+            # slots (removed |= True) or insert as dead slots
+            cap = {
+                "valid": (op["rm_rep"] != SENTINEL) & is_tomb,
+                "tag_rep": op["rm_rep"],
+                "tag_ctr": op["rm_ctr"],
+                "elem": op["rm_elem"],
+                "removed": jnp.ones_like(op["rm_rep"], bool),
+            }
+            capn = added["tag_rep"].shape[-1]
+            merged, _ = slot_union(added, cap, KEY_FIELDS, _combine,
+                                   capacity=capn)
+            new_row = {
+                f: jnp.where(is_tomb, merged[f], added[f]) for f in row
+            }
         else:
-            within = jnp.ones_like(row["valid"])
-        rm_mask = row["valid"] & (row["elem"] == op["a0"]) & within
-        clear_mask = row["valid"] & within
-        tomb = jnp.where(
-            en & (op["op"] == OP_REMOVE),
-            rm_mask,
-            jnp.where(en & (op["op"] == OP_CLEAR), clear_mask, False),
-        )
-        new_row = {f: added[f] for f in row}
-        new_row["removed"] = added["removed"] | tomb
+            rm_mask = row["valid"] & (row["elem"] == op["a0"])
+            clear_mask = row["valid"]
+            tomb = jnp.where(
+                en & (op["op"] == OP_REMOVE),
+                rm_mask,
+                jnp.where(en & (op["op"] == OP_CLEAR), clear_mask, False),
+            )
+            new_row = {f: added[f] for f in row}
+            new_row["removed"] = added["removed"] | tomb
         st = {f: st[f].at[k].set(new_row[f]) for f in st}
         return st, None
 
@@ -194,7 +226,8 @@ SPEC = base.register_type(
         queries={"contains": contains, "live_count": live_count},
         # wire opCodes: a=add, r=remove, c=clear (ORSetCommand.cs:13-87)
         op_codes={"a": OP_ADD, "r": OP_REMOVE, "c": OP_CLEAR},
-        op_extras={"frontier": "num_nodes"},
+        op_extras={"rm_rep": "capacity", "rm_ctr": "capacity",
+                   "rm_elem": "capacity"},
         prepare_ops=prepare_ops,
     )
 )

@@ -72,5 +72,8 @@ SPEC = base.register_type(
         # (note: the reference has a bug where "d" dispatches Increment,
         # PNCounterCommand.cs:50 — not reproduced).
         op_codes={"i": OP_INC, "d": OP_DEC},
+        # scatter-add of shipped amounts: order-insensitive, reads no
+        # local state -> replay-safe without capture
+        replay_safe=True,
     )
 )

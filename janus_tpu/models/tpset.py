@@ -40,10 +40,28 @@ def _combine(p, q):
     return {"removed": p["removed"] | q["removed"]}
 
 
+def prepare_ops(state: State, ops: base.OpBatch) -> base.OpBatch:
+    """Effect capture at the origin: a remove records whether its element
+    was contained in the origin's pre-batch state (``ok[B, 1]``). Replay
+    then applies the remove as an unconditional tombstone upsert — the
+    membership gate was already decided at the origin, so replicas that
+    haven't yet seen the add still record the (sticky) tombstone and
+    converge no matter the delivery order. The reference gets the same
+    effect by shipping state snapshots (2P-Set.cs:113-126 gates Remove on
+    membership at the origin's state)."""
+    hit = state["valid"][ops["key"]] & (state["elem"][ops["key"]] == ops["a0"][:, None])
+    present = jnp.any(hit & ~state["removed"][ops["key"]], axis=-1)
+    ok = jnp.where(ops["op"] == OP_REMOVE, present, True)
+    return {**ops, "ok": ok[:, None].astype(jnp.int32)}
+
+
 def apply_ops(state: State, ops: base.OpBatch) -> State:
     """add: a0=elem — insert if absent (re-add of a removed elem is a no-op
-    on the lookup, as the tombstone stays). remove: a0=elem — tombstone
-    only when currently added (reference gates Remove on membership)."""
+    on the lookup, as the tombstone stays). remove: a0=elem — with a
+    captured ``ok`` flag, upserts a sticky tombstone record (insert if
+    absent, so a late-arriving add cannot resurrect); without capture
+    (host-direct use), tombstones only when currently added."""
+    has_capture = "ok" in ops
 
     def step(st, op):
         k = op["key"]
@@ -58,11 +76,18 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
             lambda old, new: {"removed": old["removed"]},
             enabled=is_add,
         )
-        hit = row["valid"] & (row["elem"] == op["a0"])
-        present = jnp.any(hit & ~row["removed"])
-        tomb = jnp.where(is_rm & present, hit, False)
-        out = {f: added[f] for f in row}
-        out["removed"] = added["removed"] | tomb
+        if has_capture:
+            out = row_upsert(
+                added, KEY_FIELDS, (op["a0"],), {"removed": jnp.bool_(True)},
+                lambda old, new: {"removed": jnp.bool_(True)},
+                enabled=is_rm & (op["ok"][0] != 0),
+            )
+        else:
+            hit = row["valid"] & (row["elem"] == op["a0"])
+            present = jnp.any(hit & ~row["removed"])
+            tomb = jnp.where(is_rm & present, hit, False)
+            out = {f: added[f] for f in row}
+            out["removed"] = added["removed"] | tomb
         st = {f: st[f].at[k].set(out[f]) for f in st}
         return st, None
 
@@ -99,5 +124,7 @@ SPEC = base.register_type(
         merge=merge,
         queries={"contains": contains, "live_count": live_count},
         op_codes={"a": OP_ADD, "r": OP_REMOVE},
+        op_extras={"ok": 1},
+        prepare_ops=prepare_ops,
     )
 )

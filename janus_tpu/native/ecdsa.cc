@@ -99,16 +99,19 @@ extern "C" int janus_ecdsa_keygen(uint8_t* priv_der, int* priv_len,
                            kEVP_PKEY_CTRL_EC_PARAMGEN_CURVE_NID,
                            kNID_X9_62_prime256v1, nullptr) > 0 &&
       a->EVP_PKEY_keygen(ctx, &pkey) > 0) {
-    // i2d with caller-provided buffer: pass a pointer to our buffer; the
-    // function advances it and returns the length.
-    uint8_t* p = priv_der;
-    int n = a->i2d_PrivateKey(pkey, &p);
-    uint8_t* q = pub_der;
-    int m = a->i2d_PUBKEY(pkey, &q);
+    // i2d with a non-null pointer writes the FULL encoding before any
+    // length check could run, so query the lengths first (null output
+    // pointer) and only encode once both fit the caller's buffers.
+    int n = a->i2d_PrivateKey(pkey, nullptr);
+    int m = a->i2d_PUBKEY(pkey, nullptr);
     if (n > 0 && m > 0 && n <= *priv_len && m <= *pub_len) {
-      *priv_len = n;
-      *pub_len = m;
-      rc = 0;
+      uint8_t* p = priv_der;
+      uint8_t* q = pub_der;
+      if (a->i2d_PrivateKey(pkey, &p) == n && a->i2d_PUBKEY(pkey, &q) == m) {
+        *priv_len = n;
+        *pub_len = m;
+        rc = 0;
+      }
     }
   }
   if (pkey) a->EVP_PKEY_free(pkey);

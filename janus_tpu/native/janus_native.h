@@ -44,7 +44,7 @@ int janus_ecdsa_verify(const uint8_t* pub_der, int pub_len,
 int janus_frame_encode(const uint8_t* payload, int len, int field,
                        uint8_t* out, int out_cap);
 /* Returns bytes consumed, 0 if incomplete, negative on malformed.
- * Writes payload offset/length into *off/*plen. */
+ * Writes payload offset/length into *off and *plen. */
 int janus_frame_decode(const uint8_t* buf, int len, int* off, int* plen);
 
 /* ---- client-interface server ---- */

@@ -139,6 +139,9 @@ bool parse_int(const std::string& s, int64_t* out) {
   if (s.empty()) return false;
   size_t i = (s[0] == '-' || s[0] == '+') ? 1 : 0;
   if (i == s.size()) return false;
+  // untrusted TCP input: bound digits so v*10+d cannot overflow (UB);
+  // 18 digits always fit int64, longer inputs fall back to interning
+  if (s.size() - i > 18) return false;
   int64_t v = 0;
   for (; i < s.size(); i++) {
     if (s[i] < '0' || s[i] > '9') return false;
@@ -392,19 +395,30 @@ extern "C" int janus_server_reply(JanusServer* s, uint64_t client_tag,
                               int(frame.size()));
   if (fl < 0) return -1;
 
+  // The io thread closes fds and erases conns on disconnect under
+  // s->mu, so sending on the raw fd after unlock could hit a closed or
+  // kernel-reused descriptor — but holding the lock across a blocking
+  // send would let one stalled client wedge the whole io loop. dup()
+  // under the lock instead: the duplicate stays valid after the io
+  // thread's close (worst case the send fails with EPIPE).
   int fd;
   {
     std::lock_guard<std::mutex> lk(s->mu);
     auto it = s->conns.find(uint32_t(client_tag >> 32));
     if (it == s->conns.end()) return -2;
-    fd = it->second.fd;
+    fd = ::dup(it->second.fd);
+    if (fd < 0) return -2;
   }
   ssize_t off = 0;
   while (off < fl) {
     ssize_t n = ::send(fd, frame.data() + off, size_t(fl - off), MSG_NOSIGNAL);
-    if (n <= 0) return -3;
+    if (n <= 0) {
+      ::close(fd);
+      return -3;
+    }
     off += n;
   }
+  ::close(fd);
   s->replies_out.fetch_add(1, std::memory_order_relaxed);
   return 0;
 }

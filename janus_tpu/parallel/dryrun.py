@@ -1,0 +1,167 @@
+"""Multi-chip dryrun body: runs the full sharded step on a virtual mesh.
+
+Executed as ``python -m janus_tpu.parallel.dryrun <n_devices>`` inside a
+subprocess whose env forces the CPU platform with n virtual devices (set
+by ``__graft_entry__.dryrun_multichip`` BEFORE jax initializes — the only
+robust way, since flags are read once at backend init). This mirrors the
+reference's multi-node-without-a-cluster test strategy
+(Tests/KVStoreTests.cs:16-80: four full server stacks in one process).
+
+Two checks, both bit-exact sharded-vs-unsharded:
+
+1. Fast path: one anti-entropy engine tick (apply + butterfly converge)
+   over a (replica x key) mesh — the roll-based gossip lowers to
+   collective-permute on the replica axis.
+2. Full runtime: a SafeKV cluster (DAG + Tusk + dual state) with its
+   node axis sharded over ``replica`` and its key axis over ``key`` —
+   the complete "training step" analog: submit + protocol round +
+   certify-apply + commit-apply, one jitted program.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def _mesh_factors(n_devices: int) -> tuple[int, int]:
+    """Factor n into (replica_shards, key_shards); prefer 2D so both
+    parallelism axes are exercised."""
+    key_shards = 2 if n_devices % 2 == 0 and n_devices > 2 else 1
+    return n_devices // key_shards, key_shards
+
+
+def check_fastpath(mesh, replica_shards: int, key_shards: int) -> None:
+    import jax
+
+    from janus_tpu.bench.workloads import pnc_uniform
+    from janus_tpu.models import pncounter
+    from janus_tpu.parallel.mesh import place, sharded_tick
+    from janus_tpu.runtime.engine import make_tick
+    from janus_tpu.runtime.store import replicated_init
+
+    rng = np.random.default_rng(0)
+    num_replicas = replica_shards * max(2, -(-8 // replica_shards))
+    num_keys = 16 * key_shards
+    state = replicated_init(
+        pncounter.SPEC, num_replicas, num_keys=num_keys, num_writers=num_replicas
+    )
+    ops = pnc_uniform(rng, num_replicas, num_keys, 4)
+
+    expect = np.asarray(make_tick(pncounter.SPEC)(state, ops)["p"])
+
+    state, ops = place(mesh, state, ops)
+    step = sharded_tick(pncounter.SPEC, mesh, state, ops)
+    out = step(state, ops)
+    jax.block_until_ready(out)
+    np.testing.assert_array_equal(np.asarray(out["p"]), expect)
+
+
+def _run_safekv(cfg, shard_fn, num_keys: int, ticks: int):
+    """Build a SafeKV, optionally shard its state, drive submit+tick."""
+    import jax
+
+    from janus_tpu.bench.workloads import pnc_uniform
+    from janus_tpu.models import pncounter
+    from janus_tpu.runtime.safecrdt import SafeKV
+
+    n = cfg.num_nodes
+    kv = SafeKV(cfg, pncounter.SPEC, ops_per_block=4,
+                num_keys=num_keys, num_writers=n)
+    if shard_fn is not None:
+        shard_fn(kv)
+    rng = np.random.default_rng(7)
+    for t in range(ticks):
+        ops = pnc_uniform(rng, n, num_keys, 4)
+        kv.submit(ops, safe=np.ones((n,), bool))
+        kv.tick()
+    jax.block_until_ready((kv.prospective, kv.stable))
+    return kv
+
+
+def check_safekv(mesh) -> None:
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from janus_tpu.consensus.dag import DagConfig
+
+    replica_shards = mesh.devices.shape[0]
+    # node count divisible by the replica axis, >=4 for f>=1 quorums
+    n = replica_shards * max(1, -(-4 // replica_shards))
+    key_shards = mesh.devices.shape[1]
+    num_keys = 8 * key_shards
+    cfg = DagConfig(num_nodes=n, num_rounds=8)
+
+    ref = _run_safekv(cfg, None, num_keys, ticks=6)
+
+    def shard(kv):
+        node_key = NamedSharding(mesh, P("replica", "key"))
+        node_only = NamedSharding(mesh, P("replica"))
+        repl = NamedSharding(mesh, P())
+
+        kv.prospective = jax.device_put(kv.prospective, node_key)
+        kv.stable = jax.device_put(kv.stable, node_key)
+        # node-view-leading tensors ride the replica axis; global-truth
+        # tensors (block/cert existence, edges, op payloads) replicate
+        for name in ("block_seen", "cert_seen", "node_round"):
+            kv.dag[name] = jax.device_put(kv.dag[name], node_only)
+        for name in ("edges", "block_exists", "acks", "cert_exists"):
+            kv.dag[name] = jax.device_put(kv.dag[name], repl)
+        for name in ("committed", "commit_seq", "last_wave", "commit_counter"):
+            kv.commit[name] = jax.device_put(kv.commit[name], node_only)
+        kv.ops_buffer = jax.device_put(kv.ops_buffer, repl)
+        kv.buffer_filled = jax.device_put(kv.buffer_filled, repl)
+        kv.prosp_applied = jax.device_put(kv.prosp_applied, node_only)
+        kv.stable_applied = jax.device_put(kv.stable_applied, node_only)
+
+    got = _run_safekv(cfg, shard, num_keys, ticks=6)
+
+    for fld in ("p", "n"):
+        np.testing.assert_array_equal(
+            np.asarray(got.prospective[fld]), np.asarray(ref.prospective[fld])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.stable[fld]), np.asarray(ref.stable[fld])
+        )
+    # the consensus path must actually have committed something
+    assert ref.commit_latencies().size > 0, "no commits in dryrun window"
+    np.testing.assert_array_equal(got.commit_tick, ref.commit_tick)
+
+
+def run(n_devices: int) -> None:
+    # Defensive env setup for standalone invocation; a site hook may
+    # force-register another platform ahead of CPU regardless of
+    # JAX_PLATFORMS, so pin the platform via config too (must happen
+    # before the first jax.devices() initializes a backend).
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"dryrun needs {n_devices} devices, backend "
+            f"{jax.default_backend()!r} has {len(devices)} — env must set "
+            "JAX_PLATFORMS=cpu and "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}"
+        )
+    from janus_tpu.parallel.mesh import make_mesh
+
+    replica_shards, key_shards = _mesh_factors(n_devices)
+    mesh = make_mesh(replica_shards, key_shards, devices=devices[:n_devices])
+    check_fastpath(mesh, replica_shards, key_shards)
+    check_safekv(mesh)
+    print(f"dryrun ok: mesh {replica_shards}x{key_shards} on "
+          f"{n_devices} {jax.default_backend()} devices")
+
+
+if __name__ == "__main__":
+    run(int(sys.argv[1]) if len(sys.argv) > 1 else 8)

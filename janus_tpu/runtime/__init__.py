@@ -9,5 +9,5 @@ from janus_tpu.runtime.store import (  # noqa: F401
     replicated_init,
 )
 from janus_tpu.runtime.engine import jit_tick, make_local_tick, make_tick  # noqa: F401
-from janus_tpu.runtime.safecrdt import SafeKV, apply_masked  # noqa: F401
+from janus_tpu.runtime.safecrdt import SafeKV  # noqa: F401
 from janus_tpu.runtime.keyspace import KeySpace, TypedKeySpace  # noqa: F401

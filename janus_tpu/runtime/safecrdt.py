@@ -1,5 +1,5 @@
 """SafeCRDT dual-state runtime: prospective + stable key spaces driven by
-the DAG.
+the ring-buffered DAG — runs indefinitely in bounded memory.
 
 Reference: BFT-CRDT/SafeCRDTs/SafeCRDT.cs (:19-84) — every kv-pair holds a
 *prospective* CRDT (updated immediately, converges via certified DAG
@@ -7,33 +7,45 @@ blocks) and a *stable* CRDT (updated only in Tusk's total order);
 SafeCRDTManager (:61-198) batches client updates into UpdateMessages for
 the DAG, applies consensus output to stable states, and tracks safe
 updates for deferred client acks; DAGConnectionManager (:40-50) replays
-certified blocks' updates into the replication manager.
+certified blocks' updates into the replication manager; DAG.GarbageCollect
+(:946-965) collects rounds committed everywhere.
 
 Tensor re-design: one emulated N-node cluster in one pytree.
 
     prospective  type-state with leading node axis [N, K, ...]
     stable       same shape
     ops_buffer   [W, N, B] op records: the op batch carried by block (r,s)
-                 (the UpdateMessage payload; content travels with the
-                 block, so it is global truth like ``edges``)
+                 (slot-indexed like every DAG tensor; the UpdateMessage
+                 payload — content travels with the block, so it is
+                 global truth like ``edges``)
     prosp_applied / stable_applied  bool[N, W, N]: which blocks each node
                  has folded into which state
 
 Per tick: buffered ops ride the node's next block (round_step); blocks
 newly *certified* in a node's view apply to its prospective state (gated
-by causal closure — a block applies only after its whole referenced
-history, the CheckCertificates predecessor-completeness rule); blocks
-newly *committed* (commit_view) apply to its stable state. Replicated
-replay is made order-insensitive by *effect capture*: ops whose meaning
-depends on observed state (OR-Set remove/clear) record what they observed
-at the origin (spec.prepare_ops / op_extras), the tensor analog of the
-reference shipping state snapshots rather than operations. The Tusk
-order key remains available for order-sensitive consumers (safe-update
-acks, invariant checks).
+by causal closure — the CheckCertificates predecessor-completeness rule);
+blocks newly *committed* (commit_view) apply to its stable state. Both
+applications are DELTA applications: only the op slots of newly
+applicable blocks are gathered (bounded per tick by ``apply_budget``,
+spilling to the next tick), instead of a masked replay of the whole
+window — the per-tick cost is O(budget * B), not O(W * N * B).
 
-The local (origin) replica applies its own ops to its own prospective
-immediately at submit — the reference's "plain update" fast path that
-answers the client before any network round (SafeCRDT.Update :39-62).
+Replicated replay is made order-insensitive by *effect capture*: ops
+whose meaning depends on observed state record what they observed at the
+origin (spec.prepare_ops / op_extras; see base.capture_and_apply), the
+tensor analog of the reference shipping state snapshots rather than
+operations. SafeKV refuses types that are neither replay-safe nor
+captured.
+
+Garbage collection: each tick the cluster-wide frontier advances past
+rounds that are (a) below every view's last committed anchor, (b)
+decided identically everywhere (committed sets equal, stable application
+complete, prospective application equal to the certificate set), and (c)
+structurally frozen (every node's round is past them). Their slots are
+cleared and handed to future rounds; blocks never certified/committed by
+then are abandoned, matching the reference's "assume they are already
+persisted" GC comment. Total order and latency history survive GC in
+host-side logs.
 """
 from __future__ import annotations
 
@@ -48,50 +60,38 @@ from janus_tpu.consensus import tusk
 from janus_tpu.models import base
 
 
-def _flatten_buffer(ops_buffer: base.OpBatch) -> base.OpBatch:
-    """[W, N, B, *extra] op fields -> [W*N*B, *extra] (flat order is
-    round-major, so a single scan applies blocks in causal round order)."""
-    return {
-        f: v.reshape((-1,) + v.shape[3:]) for f, v in ops_buffer.items()
-    }
-
-
-def apply_masked(spec, state, ops_buffer: base.OpBatch, mask: jnp.ndarray):
-    """Fold the op batches of masked blocks into each node's state.
-
-    state: [N_view, K, ...]; ops_buffer: [W, N, B, *extra];
-    mask: [N_view, W, N]. Ops of unselected blocks neutralize to no-ops.
-    """
-    flat = _flatten_buffer(ops_buffer)
-
-    def one_view(st, m):
-        enable = jnp.broadcast_to(
-            m[:, :, None], ops_buffer["op"].shape
-        ).reshape(-1)
-        ops = dict(flat)
-        ops["op"] = jnp.where(enable, flat["op"], base.OP_NOOP)
-        return spec.apply_ops(st, ops)
-
-    return jax.vmap(one_view)(state, mask)
-
-
 class SafeKV:
     """An emulated N-node Reliable-CRDT cluster for one replicated type.
 
     The composition root (the JanusService.Init analog, JanusService.cs:
     36-72) wiring DAG + Tusk + dual state + safe-update tracking into one
     steppable object. All device work happens in two jitted programs:
-    ``submit`` (local apply + buffer) and ``tick`` (round + certify-apply
-    + commit-apply).
+    ``submit`` (local apply + buffer) and ``tick`` (round + commit +
+    delta-apply + GC).
     """
 
     def __init__(self, cfg: dagmod.DagConfig, spec, ops_per_block: int,
-                 seed: int = 0, **dims):
+                 seed: int = 0, apply_budget: int | None = None,
+                 commit_steps: int = 2, collect: bool = True, **dims):
         self.cfg = cfg
         self.spec = spec
         self.B = ops_per_block
         self.seed = seed
+        self.commit_steps = commit_steps
+        self.collect = collect
         n, w = cfg.num_nodes, cfg.num_rounds
+        # blocks applied per view per tick; steady state certifies N new
+        # blocks per tick, so 4N gives catch-up headroom
+        self.apply_budget = apply_budget if apply_budget is not None else 4 * n
+
+        if not (spec.replay_safe or spec.prepare_ops is not None):
+            raise ValueError(
+                f"type {spec.name!r} is not replay-safe: its apply_ops "
+                "reads uncaptured local state, so replicated replay under "
+                "differing certify/commit batchings would silently "
+                "diverge. Give it prepare_ops effect capture or declare "
+                "replay_safe=True."
+            )
 
         one = spec.init(**dims)
         rep = lambda x: jnp.broadcast_to(x, (n,) + x.shape).copy()
@@ -100,10 +100,12 @@ class SafeKV:
         self.dag = dagmod.init(cfg)
         self.commit = tusk.init_commit(cfg)
         # op payload per block slot; effect-capture extras resolve their
-        # width against the type dims (+ the cluster size)
+        # width against the type dims (+ the cluster size), or are
+        # literal ints
         dim_env = {**dims, "num_nodes": n}
         self.extra_widths = {
-            name: int(dim_env[dim]) for name, dim in spec.op_extras.items()
+            name: (int(dim_env[dim]) if isinstance(dim, str) else int(dim))
+            for name, dim in spec.op_extras.items()
         }
         self.ops_buffer = {
             f: jnp.zeros((w, n, self.B), jnp.int32) for f in base.OP_FIELDS
@@ -113,16 +115,21 @@ class SafeKV:
         self.buffer_filled = jnp.zeros((w, n), bool)
         self.prosp_applied = jnp.zeros((n, w, n), bool)
         self.stable_applied = jnp.zeros((n, w, n), bool)
-        # host-side bookkeeping: submit/commit tick per block slot (for
-        # op->serializable-commit latency) and safe-op flags for acks
+        # host-side bookkeeping, all survives GC:
+        #   submit/commit tick per live slot (op->serializable-commit
+        #   latency), safe-op flags for deferred acks, the append-only
+        #   per-view total-order log, and completed-latency history
         self.submit_tick = np.full((w, n), -1, np.int64)
         self.commit_tick = np.full((w, n), -1, np.int64)
         self.safe_host = np.zeros((w, n, self.B), bool)
         self.last_safe_acks = np.zeros((w, n, self.B), bool)
         self.tick_count = 0
+        self.latency_log: list[int] = []
+        self.commit_log: list[list[tuple[int, int]]] = [[] for _ in range(n)]
+        self._host_slot_round = np.arange(w, dtype=np.int64)
 
         self._jit_submit = jax.jit(self._submit_device)
-        self._jit_tick = jax.jit(self._tick_device, static_argnames=("sync_commit",))
+        self._jit_tick = jax.jit(self._tick_device)
 
     # -- device programs ---------------------------------------------------
 
@@ -132,36 +139,36 @@ class SafeKV:
         n = cfg.num_nodes
         vs = jnp.arange(n)
         r = dag_state["node_round"]  # the round the next block will occupy
+        s = dagmod.slot_of(cfg, r)
 
         # Reject ops for sealed slots: the block already exists (stalled
-        # node) OR a batch was already buffered for this round and not yet
-        # blockified (double submit between ticks). The reference
-        # re-queues; here the host resubmits on a False accept bit
-        # (DAG.cs:774-812).
-        accepted = (~dag_state["block_exists"][r, vs]
-                    & ~buffer_filled[r, vs])  # [N]
+        # node), a batch is already buffered for this round, or the GC
+        # window is full (back-pressure). The reference re-queues; here
+        # the host resubmits on a False accept bit (DAG.cs:774-812).
+        accepted = (~dag_state["block_exists"][s, vs]
+                    & ~buffer_filled[s, vs]
+                    & (r < dag_state["base_round"] + cfg.num_rounds))  # [N]
         acc_ops = {
             f: jnp.where(accepted[:, None], ops[f], base.OP_NOOP if f == "op" else 0)
             for f in base.OP_FIELDS
         }
-        for name, width in self.extra_widths.items():
-            acc_ops[name] = jnp.zeros((n, self.B, width), jnp.int32)
-        # effect capture against the origin's pre-apply prospective state
-        if self.spec.prepare_ops is not None:
-            acc_ops = jax.vmap(self.spec.prepare_ops)(prospective, acc_ops)
+        # Sequential effect capture + origin fast-path apply in one pass:
+        # each op's capture observes earlier ops of its own batch (a
+        # batch [add v, use v] must work — per-object serialization,
+        # PNCounterCommand.cs:29), and the origin's prospective state is
+        # exactly the replay of the captured ops.
+        new_prosp, acc_ops = jax.vmap(
+            lambda st, o: base.capture_and_apply(self.spec, st, o)
+        )(prospective, acc_ops)
 
         def buf_set(f):
-            cur = ops_buffer[f][r, vs]
+            cur = ops_buffer[f][s, vs]
             acc = accepted.reshape((n,) + (1,) * (acc_ops[f].ndim - 1))
-            return ops_buffer[f].at[r, vs].set(jnp.where(acc, acc_ops[f], cur))
+            return ops_buffer[f].at[s, vs].set(jnp.where(acc, acc_ops[f], cur))
 
         new_buffer = {f: buf_set(f) for f in ops_buffer}
-        new_filled = buffer_filled.at[r, vs].max(accepted)
-
-        # origin applies its own (accepted) ops immediately — the
-        # prospective fast path
-        new_prosp = jax.vmap(self.spec.apply_ops)(prospective, acc_ops)
-        new_applied = prosp_applied.at[vs, r, vs].max(accepted)
+        new_filled = buffer_filled.at[s, vs].max(accepted)
+        new_applied = prosp_applied.at[vs, s, vs].max(accepted)
         return new_prosp, new_buffer, new_filled, new_applied, accepted
 
     def _causal_closure(self, dag_state, applied):
@@ -169,43 +176,177 @@ class SafeKV:
         applied, and every referenced predecessor already applied (or
         becoming applicable this tick, earlier in round order). The
         reference's predecessor-completeness gate (CheckCertificates,
-        DAG.cs:629-714) — without it, op replay could run ahead of its
-        causal past when certificates arrive out of order."""
+        DAG.cs:629-714). Ring-aware: the logical predecessor of slot s is
+        its ring-predecessor, except for the slot holding ``base_round``
+        whose predecessor was collected (hence applied) by definition."""
         cfg = self.cfg
         edges = dag_state["edges"]
         cert_seen = dag_state["cert_seen"]
-        for _ in range(cfg.num_rounds):
-            ones = jnp.ones_like(applied[:, :1])
-            prev_applied = jnp.concatenate([ones, applied[:, :-1]], axis=1)
-            # viol[v,r,s] = some referenced (r-1,t) not applied in view v
+        is_base = dag_state["slot_round"] == dag_state["base_round"]  # [W]
+
+        def body(_, applied):
+            prev_applied = jnp.roll(applied, 1, axis=1)
+            prev_applied = jnp.where(is_base[None, :, None], True, prev_applied)
+            # viol[v,s,src] = some referenced predecessor not applied in v
             viol = jnp.any(
                 edges[None, :, :, :] & ~prev_applied[:, :, None, :], axis=-1
             )
             applicable = cert_seen & ~applied & ~viol
-            applied = applied | applicable
-        return applied
+            return applied | applicable
+
+        return jax.lax.fori_loop(0, cfg.num_rounds, body, applied)
+
+    def _delta_apply(self, state, ops_buffer, select, order_key):
+        """Apply the op batches of selected blocks, lowest key first,
+        bounded by apply_budget; returns (state, applied_mask).
+
+        select/order_key: [N_view, W, N]. Up to ``apply_budget`` blocks
+        per view apply this tick; the rest keep their select bit clear
+        and spill to the next tick (order is irrelevant for state —
+        replay-safe ops commute — but ordered selection keeps ack
+        bookkeeping and budget spill deterministic)."""
+        cfg = self.cfg
+        w, n = cfg.num_rounds, cfg.num_nodes
+        a = min(self.apply_budget, w * n)
+        inf = jnp.iinfo(jnp.int32).max
+        flat_ops = {
+            f: v.reshape((w * n,) + v.shape[2:]) for f, v in ops_buffer.items()
+        }
+
+        def one_view(st, sel, key):
+            k = jnp.where(sel, key, inf).reshape(w * n)
+            idx = jnp.argsort(k)[:a]
+            chosen = k[idx] < inf  # [A]
+            rows = {f: v[idx] for f, v in flat_ops.items()}  # [A, B, ...]
+            rows["op"] = jnp.where(chosen[:, None], rows["op"], base.OP_NOOP)
+            batch = {
+                f: v.reshape((a * self.B,) + v.shape[2:])
+                for f, v in rows.items()
+            }
+            st = self.spec.apply_ops(st, batch)
+            sel_mask = (
+                jnp.zeros((w * n,), bool).at[idx].set(chosen).reshape(w, n)
+            )
+            return st, sel_mask
+
+        return jax.vmap(one_view)(state, select, order_key)
+
+    def _state_transfer(self, prospective, stable, dag_state, cstate,
+                        prosp_applied, stable_applied):
+        """Crash/lag recovery: a view that fell below the GC frontier or
+        whose commit cursor lags the cluster beyond the repair window
+        adopts a snapshot from the most-advanced view (the donor). This
+        is the restart-from-peer-state a real crashed replica performs —
+        the reference has no equivalent (its lagging replicas can only
+        self-repair within the retained window via BlockQueryMessage,
+        DAG.cs:612-621); checkpoint/state-transfer is an explicit
+        capability addition (SURVEY §5 checkpoint/resume)."""
+        cfg = self.cfg
+        lw = cstate["last_wave"]
+        # quorum-th best view's commit cursor: the cluster's decided level
+        lw_q = jnp.sort(lw)[cfg.num_nodes - cfg.quorum]
+        lag_max = max(2, cfg.num_rounds // 4)
+        need = (dag_state["node_round"] < dag_state["base_round"]) | (
+            lw < lw_q - lag_max
+        )  # [N]
+        donor = jnp.argmax(lw)
+
+        def adopt(x, view_axis=0):
+            take = jnp.take(x, donor, axis=view_axis)
+            shape = [1] * x.ndim
+            shape[view_axis] = cfg.num_nodes
+            m = need.reshape(shape)
+            return jnp.where(m, jnp.expand_dims(take, view_axis), x)
+
+        prospective = jax.tree.map(adopt, prospective)
+        stable = jax.tree.map(adopt, stable)
+        dag_state = dict(dag_state)
+        for f in ("block_seen", "cert_seen"):
+            dag_state[f] = adopt(dag_state[f])
+        dag_state["node_round"] = adopt(dag_state["node_round"])
+        cstate = dict(cstate)
+        for f in ("committed", "commit_seq", "last_wave", "eval_wave",
+                  "commit_counter"):
+            cstate[f] = adopt(cstate[f])
+        prosp_applied = adopt(prosp_applied)
+        stable_applied = adopt(stable_applied)
+        return (prospective, stable, dag_state, cstate, prosp_applied,
+                stable_applied, need)
 
     def _tick_device(self, prospective, stable, dag_state, cstate, ops_buffer,
                      prosp_applied, stable_applied,
                      active: Optional[jnp.ndarray],
-                     withhold: Optional[jnp.ndarray],
-                     sync_commit: bool = True):
+                     withhold: Optional[jnp.ndarray]):
         cfg = self.cfg
+        w, n = cfg.num_rounds, cfg.num_nodes
+
+        # -- recovery first: transferred views join the current frontier
+        (prospective, stable, dag_state, cstate, prosp_applied,
+         stable_applied, transferred) = self._state_transfer(
+            prospective, stable, dag_state, cstate, prosp_applied,
+            stable_applied)
+
         dag_state = dagmod.round_step(cfg, dag_state, active, withhold)
 
-        prosp_now = self._causal_closure(dag_state, prosp_applied)
-        new_cert = prosp_now & ~prosp_applied
-        prospective = apply_masked(self.spec, prospective, ops_buffer, new_cert)
-        prosp_applied = prosp_now
+        # -- prospective: delta-apply newly certified, causally-ready blocks
+        prosp_ready = self._causal_closure(dag_state, prosp_applied)
+        rel_round = (dag_state["slot_round"] - dag_state["base_round"])
+        round_key = rel_round[None, :, None] * n + jnp.arange(n)[None, None, :]
+        prospective, prosp_sel = self._delta_apply(
+            prospective, ops_buffer, prosp_ready & ~prosp_applied,
+            jnp.broadcast_to(round_key, (n, w, n)),
+        )
+        prosp_applied = prosp_applied | prosp_sel
 
-        if sync_commit:
-            cstate = tusk.commit_view(cfg, dag_state, cstate, seed=self.seed)
-        # committed sets are causal closures already (Tusk commits a
-        # leader's whole reachable history), so no extra gate is needed
-        new_com = cstate["committed"] & ~stable_applied
-        stable = apply_masked(self.spec, stable, ops_buffer, new_com)
-        stable_applied = stable_applied | cstate["committed"]
-        return prospective, stable, dag_state, cstate, prosp_applied, stable_applied, new_com
+        # -- commit + stable: delta-apply newly committed blocks in order
+        com_before = cstate["committed"]
+        cstate = tusk.commit_view(cfg, dag_state, cstate, seed=self.seed,
+                                  steps=self.commit_steps)
+        fresh_com = cstate["committed"] & ~com_before  # first-commit events
+        seq_snap = cstate["commit_seq"]                # pre-GC, for host log
+        pending = cstate["committed"] & ~stable_applied  # incl. budget spill
+        ckey = tusk.order_key(cfg, cstate, base=dag_state["base_round"])
+        stable, stable_sel = self._delta_apply(stable, ops_buffer, pending, ckey)
+        stable_applied = stable_applied | stable_sel
+
+        # -- GC: advance the frontier past rounds finished everywhere
+        if self.collect:
+            com = cstate["committed"]
+            com_consistent = jnp.all(com.all(0) == com.any(0), axis=-1)   # [W]
+            stable_done = jnp.all(stable_applied == com, axis=(0, 2))     # [W]
+            # prospective application must equal the certificate set —
+            # except the origin's own pre-certification fast-path apply
+            # of a block that never certified (allowed residue)
+            diag = jnp.eye(n, dtype=bool)[:, None, :]                # [N,1,N]
+            mism = prosp_applied != dag_state["cert_exists"][None]
+            allowed = diag & prosp_applied & ~dag_state["cert_exists"][None]
+            prosp_done = jnp.all(~mism | allowed, axis=(0, 2))            # [W]
+            lw_min = jnp.min(cstate["last_wave"])
+            below_anchor = dag_state["slot_round"] < 2 * lw_min
+            frozen = dag_state["slot_round"] + 2 <= jnp.min(dag_state["node_round"])
+            collectible = (com_consistent & stable_done & prosp_done
+                           & below_anchor & frozen)
+            in_order = collectible[
+                dagmod.slot_of(cfg, dag_state["base_round"] + jnp.arange(w))
+            ]
+            adv = jnp.sum(jnp.cumprod(in_order.astype(jnp.int32)))
+            new_base = dag_state["base_round"] + adv
+            dead = dag_state["slot_round"] < new_base  # [W]
+            dag_state = dagmod.recycle(cfg, dag_state, new_base)
+            cstate = tusk.recycle_commit(cfg, cstate, new_base)
+            ops_buffer = {
+                f: jnp.where(dead.reshape((w,) + (1,) * (v.ndim - 1)), 0, v)
+                for f, v in ops_buffer.items()
+            }
+            prosp_applied = jnp.where(dead[None, :, None], False, prosp_applied)
+            stable_applied = jnp.where(dead[None, :, None], False, stable_applied)
+            recycled = dead
+        else:
+            recycled = jnp.zeros((w,), bool)
+
+        return (prospective, stable, dag_state, cstate, ops_buffer,
+                prosp_applied, stable_applied, fresh_com, seq_snap,
+                recycled, transferred)
 
     # -- host API ----------------------------------------------------------
 
@@ -213,39 +354,73 @@ class SafeKV:
         """Buffer one [N, B] op batch (rides each node's next block) and
         apply each node's own ops to its prospective state. Returns the
         [N] accepted mask (False = that node's current block slot is
-        sealed or already buffered; resubmit after the next tick)."""
+        sealed, already buffered, or the GC window is full; resubmit
+        after the next tick)."""
         r = np.asarray(self.dag["node_round"])
+        s = r % self.cfg.num_rounds
         (self.prospective, self.ops_buffer, self.buffer_filled,
          self.prosp_applied, accepted) = self._jit_submit(
             self.prospective, self.dag, self.ops_buffer, self.buffer_filled,
             self.prosp_applied, ops)
         acc = np.asarray(accepted)
         vs = np.arange(self.cfg.num_nodes)
-        self.submit_tick[r[acc], vs[acc]] = self.tick_count
+        self.submit_tick[s[acc], vs[acc]] = self.tick_count
         if safe is not None:
-            self.safe_host[r[acc], vs[acc]] = np.asarray(safe, bool)[acc]
+            self.safe_host[s[acc], vs[acc]] = np.asarray(safe, bool)[acc]
         return acc
 
     def tick(self, active=None, withhold=None) -> np.ndarray:
-        """One protocol round + state application. Returns the [N, W, N]
-        mask of blocks newly committed per node view this tick (the
-        safe-update completion signal: a node's safe ops are acked when
-        its own block commits in its own view)."""
+        """One protocol round + delta state application + GC. Returns the
+        [N, W, N] mask of blocks newly committed per node view this tick
+        (slot-indexed; the safe-update completion signal: a node's safe
+        ops are acked when its own block commits in its own view)."""
         (self.prospective, self.stable, self.dag, self.commit,
-         self.prosp_applied, self.stable_applied, new_com) = self._jit_tick(
+         self.ops_buffer, self.prosp_applied, self.stable_applied,
+         fresh_com, seq_snap, recycled, transferred) = self._jit_tick(
             self.prospective, self.stable, self.dag, self.commit,
             self.ops_buffer, self.prosp_applied, self.stable_applied,
             active, withhold)
         self.tick_count += 1
-        new_com = np.asarray(new_com)
-        # op->serializable-commit bookkeeping: a block's latency is
-        # measured when it commits in its *origin's own* view — also the
-        # deferred safe-update ack point (ClientInterface.cs:186-190)
-        own = new_com[np.arange(self.cfg.num_nodes), :, np.arange(self.cfg.num_nodes)].T
+        fresh_com = np.asarray(fresh_com)
+
+        # a transferred (crash-recovered) view adopts the donor's commit
+        # history wholesale — mirror that in the host-side log
+        trans = np.asarray(transferred)
+        if trans.any():
+            donor = int(np.argmax([len(l) for l in self.commit_log]))
+            for v in np.nonzero(trans)[0]:
+                self.commit_log[int(v)] = list(self.commit_log[donor])
+
+        # host bookkeeping: latency at own-view commit (the deferred
+        # safe-update ack point, ClientInterface.cs:186-190), plus the
+        # append-only per-view total-order log (survives GC)
+        vs = np.arange(self.cfg.num_nodes)
+        own = fresh_com[vs, :, vs].T  # [W, N]
         newly = own & (self.submit_tick >= 0) & (self.commit_tick < 0)
         self.commit_tick[newly] = self.tick_count
+        self.latency_log.extend(
+            (self.tick_count - self.submit_tick[newly]).tolist()
+        )
         self.last_safe_acks = newly[:, :, None] & self.safe_host
-        return new_com
+
+        seqs = np.asarray(seq_snap)
+        rounds = self._host_slot_round
+        for v in range(self.cfg.num_nodes):
+            ss, src = np.nonzero(fresh_com[v])
+            if ss.size:
+                order = np.lexsort((src, rounds[ss], seqs[v, ss, src]))
+                self.commit_log[v].extend(
+                    (int(rounds[ss[i]]), int(src[i])) for i in order
+                )
+
+        # recycled slots: reset host-side per-slot tracking
+        rec = np.asarray(recycled)
+        if rec.any():
+            self.submit_tick[rec] = -1
+            self.commit_tick[rec] = -1
+            self.safe_host[rec] = False
+        self._host_slot_round = np.asarray(self.dag["slot_round"]).astype(np.int64)
+        return fresh_com
 
     def safe_acks(self) -> np.ndarray:
         """[W, N, B] mask of safe ops acked by the latest tick: the op's
@@ -256,9 +431,12 @@ class SafeKV:
 
     def commit_latencies(self) -> np.ndarray:
         """Ticks from submit to stable commit in the origin's own view,
-        for every block that has completed the full path."""
-        done = (self.submit_tick >= 0) & (self.commit_tick >= 0)
-        return (self.commit_tick - self.submit_tick)[done]
+        for every block that completed the full path (survives GC)."""
+        return np.asarray(self.latency_log, dtype=np.int64)
+
+    def base_round(self) -> int:
+        """Current GC frontier (lowest live logical round)."""
+        return int(np.asarray(self.dag["base_round"]))
 
     def query_prospective(self, name: str, *args):
         q = self.spec.queries[name]
@@ -269,4 +447,6 @@ class SafeKV:
         return jax.vmap(q, in_axes=(0,) + (None,) * len(args))(self.stable, *args)
 
     def ordered_commits(self, node: int):
-        return tusk.ordered_blocks(self.cfg, self.commit, node)
+        """The node's full committed total order, (round, source) pairs,
+        from the host-side append-only log (GC-proof)."""
+        return list(self.commit_log[node])

@@ -1,0 +1,204 @@
+"""Replay-safety property tests: every registered type's replicated
+replay must converge under arbitrary delivery orders.
+
+The reference sidesteps this by shipping full state snapshots and joining
+them (ReplicationManager.cs:347-357 — merge is commutative by
+construction). This framework ships *ops* inside consensus payloads
+(SafeKV ops_buffer), so op application after effect capture must itself
+be order-insensitive: for any captured batch, applying its ops in any
+interleaving on any two replicas and joining must agree. The round-1
+advisor found ORSet violating this; these tests pin the fix for every
+type (Tests analog: MergeSharp.Tests per-type convergence suites).
+"""
+import numpy as np
+
+from janus_tpu.models import base, graph, lwwset, mvregister, orset, tpset
+
+
+def _split_ops(ops, idx):
+    return {f: v[idx] for f, v in ops.items()}
+
+
+def _apply_sequence(spec, init_state, prepared, order):
+    """Apply single-op batches in the given order onto a fresh state."""
+    st = init_state
+    for i in order:
+        one = {f: v[i : i + 1] for f, v in prepared.items()}
+        st = spec.apply_ops(st, one)
+    return st
+
+
+def _assert_replay_commutes(spec, init_state, origin_state, ops, perms,
+                            canon=None):
+    """Capture ops against origin_state; apply them to fresh replicas in
+    several orders; all pairwise joins must be bit-identical."""
+    prepared = spec.prepare_ops(origin_state, ops)
+    states = [
+        _apply_sequence(spec, init_state, prepared, perm) for perm in perms
+    ]
+    joined = [spec.merge(s, states[0]) for s in states]
+    if canon is not None:
+        joined = [canon(s) for s in joined]
+    for other in joined[1:]:
+        for f in joined[0]:
+            np.testing.assert_array_equal(
+                np.asarray(joined[0][f]), np.asarray(other[f]),
+                err_msg=f"{spec.name}: field {f} diverged across orders",
+            )
+
+
+def test_orset_replay_orders_converge():
+    origin = orset.init(2, 8)
+    origin = orset.apply_ops(origin, base.make_op_batch(
+        op=[orset.OP_ADD, orset.OP_ADD], key=[0, 1], a0=[7, 9],
+        a1=[0, 0], a2=[1, 2]))
+    ops = base.make_op_batch(
+        op=[orset.OP_ADD, orset.OP_REMOVE, orset.OP_CLEAR],
+        key=[0, 0, 1], a0=[7, 7, 0], a1=[1, 0, 0], a2=[1, 0, 0])
+    # fresh replicas that already hold the origin's adds in one case and
+    # nothing in the other: both directions of "late delivery"
+    _assert_replay_commutes(
+        orset.SPEC, origin, origin, ops,
+        perms=[(0, 1, 2), (2, 1, 0), (1, 0, 2)])
+    _assert_replay_commutes(
+        orset.SPEC, orset.init(2, 8), origin, ops,
+        perms=[(0, 1, 2), (2, 1, 0), (1, 0, 2)])
+
+
+def test_tpset_replay_orders_converge():
+    origin = tpset.init(1, 8)
+    origin = tpset.apply_ops(origin, base.make_op_batch(
+        op=[tpset.OP_ADD], key=[0], a0=[5]))
+    ops = base.make_op_batch(
+        op=[tpset.OP_ADD, tpset.OP_REMOVE], key=[0, 0], a0=[6, 5])
+    _assert_replay_commutes(
+        tpset.SPEC, tpset.init(1, 8), origin, ops,
+        perms=[(0, 1), (1, 0)])
+    # the gated remove fires even on a replica that never saw the add
+    prepared = tpset.SPEC.prepare_ops(origin, ops)
+    fresh = _apply_sequence(tpset.SPEC, tpset.init(1, 8), prepared, [1])
+    late = tpset.apply_ops(fresh, base.make_op_batch(
+        op=[tpset.OP_ADD], key=[0], a0=[5]))
+    assert not bool(tpset.contains(late, 0, 5))
+
+
+def test_lwwset_replay_orders_converge():
+    origin = lwwset.init(1, 8)
+    origin = lwwset.apply_ops(origin, base.make_op_batch(
+        op=[lwwset.OP_ADD], key=[0], a0=[5], a1=[1], a2=[10]))
+    ops = base.make_op_batch(
+        op=[lwwset.OP_ADD, lwwset.OP_REMOVE], key=[0, 0],
+        a0=[6, 5], a1=[1, 1], a2=[20, 30])
+    _assert_replay_commutes(
+        lwwset.SPEC, lwwset.init(1, 8), origin, ops,
+        perms=[(0, 1), (1, 0)])
+    # remove-before-add delivery: stamps still land, LWW decides
+    prepared = lwwset.SPEC.prepare_ops(origin, ops)
+    fresh = _apply_sequence(lwwset.SPEC, lwwset.init(1, 8), prepared, [1])
+    late = lwwset.apply_ops(fresh, base.make_op_batch(
+        op=[lwwset.OP_ADD], key=[0], a0=[5], a1=[1], a2=[10]))
+    assert not bool(lwwset.contains(late, 0, 5))  # rm stamp (1,30) wins
+
+
+def test_mvregister_replay_orders_converge():
+    origin = mvregister.init(1, num_writers=4, capacity=4)
+    origin = mvregister.apply_ops(origin, base.make_op_batch(
+        op=[mvregister.OP_WRITE], key=[0], a0=[100], writer=[0]))
+    ops = base.make_op_batch(
+        op=[mvregister.OP_WRITE, mvregister.OP_WRITE], key=[0, 0],
+        a0=[200, 300], writer=[1, 2])
+    _assert_replay_commutes(
+        mvregister.SPEC, mvregister.init(1, 4, 4), origin, ops,
+        perms=[(0, 1), (1, 0)])
+    # both writes observed (100) but not each other -> concurrent pair
+    prepared = mvregister.SPEC.prepare_ops(origin, ops)
+    st = _apply_sequence(mvregister.SPEC, origin, prepared, [0, 1])
+    assert int(mvregister.num_values(st)[0]) == 2
+
+
+def test_mvregister_same_writer_batch_stays_ordered():
+    """Through the runtime capture path (capture_and_apply), a later
+    same-key write in one batch observes the earlier one: its clock
+    strictly dominates, so only the last value survives."""
+    origin = mvregister.init(1, num_writers=4, capacity=4)
+    ops = base.make_op_batch(
+        op=[mvregister.OP_WRITE, mvregister.OP_WRITE], key=[0, 0],
+        a0=[1, 2], writer=[3, 3])
+    st, prepared = base.capture_and_apply(mvregister.SPEC, origin, ops)
+    assert prepared["wclock"][1, 3] == prepared["wclock"][0, 3] + 1
+    vals, valid = mvregister.read(st, 0)
+    live = set(np.asarray(vals)[np.asarray(valid)].tolist())
+    assert live == {2}
+
+
+def test_graph_replay_orders_converge():
+    origin = graph.init(1, v_capacity=8, e_capacity=8)
+    origin = graph.apply_ops(origin, base.make_op_batch(
+        op=[graph.OP_ADD_VERTEX, graph.OP_ADD_VERTEX, graph.OP_ADD_EDGE],
+        key=[0, 0, 0], a0=[1, 2, 1], a1=[0, 0, 2]))
+    ops = base.make_op_batch(
+        op=[graph.OP_REMOVE_EDGE, graph.OP_ADD_VERTEX],
+        key=[0, 0], a0=[1, 3], a1=[2, 0])
+    _assert_replay_commutes(
+        graph.SPEC, graph.init(1, 8, 8), origin, ops,
+        perms=[(0, 1), (1, 0)])
+    # gated ops: remove-vertex with a live incident edge was rejected at
+    # capture time and stays rejected on every replica
+    rv = base.make_op_batch(
+        op=[graph.OP_REMOVE_VERTEX], key=[0], a0=[1])
+    prepared = graph.SPEC.prepare_ops(origin, rv)
+    assert prepared["ok"][0, 0] == 0
+    st = graph.apply_ops(origin, prepared)
+    assert bool(graph.contains_vertex(st, 0, 1))
+
+
+def test_intra_batch_dependency_captured_sequentially():
+    """A batch [add_vertex v, add_vertex w, add_edge v->w] submitted to
+    SafeKV must yield the edge on every replica: each op's capture
+    observes earlier ops of its own batch (capture_and_apply), matching
+    the reference's per-object op serialization."""
+    from janus_tpu.consensus import DagConfig
+    from janus_tpu.runtime.safecrdt import SafeKV
+
+    N, B = 4, 4
+    kv = SafeKV(DagConfig(N, 8), graph.SPEC, ops_per_block=B,
+                num_keys=2, v_capacity=8, e_capacity=8)
+    op = np.zeros((N, B), np.int32)
+    key = np.zeros((N, B), np.int32)
+    a0 = np.zeros((N, B), np.int32)
+    a1 = np.zeros((N, B), np.int32)
+    op[0, :3] = [graph.OP_ADD_VERTEX, graph.OP_ADD_VERTEX, graph.OP_ADD_EDGE]
+    a0[0, :3] = [1, 2, 1]
+    a1[0, 2] = 2
+    kv.submit(base.make_op_batch(op=op, key=key, a0=a0, a1=a1))
+    # origin sees the edge instantly (fast path)
+    assert bool(np.asarray(kv.query_prospective("edge_count"))[0, 0] == 1)
+    for _ in range(4):
+        kv.tick()
+    counts = np.asarray(kv.query_stable("edge_count"))[:, 0]
+    assert (counts == 1).all(), counts
+
+
+def test_safekv_rejects_uncaptured_spec():
+    import dataclasses
+
+    import pytest
+
+    from janus_tpu.consensus import DagConfig
+    from janus_tpu.models import pncounter
+    from janus_tpu.runtime.safecrdt import SafeKV
+
+    bad = dataclasses.replace(
+        pncounter.SPEC, name="Unsafe", type_code="_unsafe_test",
+        replay_safe=False, prepare_ops=None)
+    with pytest.raises(ValueError, match="not replay-safe"):
+        SafeKV(DagConfig(4, 8), bad, ops_per_block=2,
+               num_keys=2, num_writers=4)
+
+
+def test_every_registered_type_is_replay_safe():
+    """The registry-wide guarantee the runtime relies on."""
+    for code, spec in base.registered_types().items():
+        assert spec.replay_safe or spec.prepare_ops is not None, (
+            f"type {code} is neither replay_safe nor effect-captured"
+        )

@@ -169,27 +169,46 @@ def test_keyspace_assignment_and_capacity():
     assert ks.create("orset", "carol") == 0  # independent per type
 
 
-def test_orset_frontier_replay_commutes_model_level():
+def test_orset_capture_replay_commutes_model_level():
     """Regression: remove must tombstone what the *origin observed*
-    (captured frontier), not whatever is present at apply time, so that
+    (captured tag set), not whatever is present at apply time, so that
     replicas applying [add, remove] vs [remove, add] converge."""
-    import jax
     origin = orset.init(1, 8)
     origin = orset.apply_ops(origin, base.make_op_batch(
         op=[orset.OP_ADD], key=[0], a0=[7], a1=[0], a2=[1]))
-    rm = base.make_op_batch(op=[orset.OP_REMOVE], key=[0], a0=[7])
-    rm["frontier"] = np.zeros((1, 4), np.int32)
-    rm = orset.prepare_ops(origin, rm)
-    assert rm["frontier"][0, 0] == 1  # observed tag (0, 1)
+    rm = orset.prepare_ops(
+        origin, base.make_op_batch(op=[orset.OP_REMOVE], key=[0], a0=[7]))
+    assert rm["rm_rep"][0, 0] == 0 and rm["rm_ctr"][0, 0] == 1
+    # an UNOBSERVED concurrent add (fresh tag (0,2)) must survive the
+    # remove in either application order (add-wins)
     add2 = base.make_op_batch(op=[orset.OP_ADD], key=[0], a0=[7], a1=[0], a2=[2])
-    add2["frontier"] = np.zeros((1, 4), np.int32)
 
     fresh = orset.init(1, 8)
     a_then_r = orset.apply_ops(orset.apply_ops(fresh, add2), rm)
     r_then_a = orset.apply_ops(orset.apply_ops(fresh, rm), add2)
-    # both orders: tag (0,2) survives (> frontier), element present
     assert bool(orset.contains(a_then_r, 0, 7))
     assert bool(orset.contains(r_then_a, 0, 7))
+
+
+def test_orset_late_observed_add_cannot_resurrect():
+    """The round-1 advisor's divergence repro: an add the remove's origin
+    HAD observed reaches another node only after the remove. The captured
+    tombstone record must kill it on arrival; replicas converge dead."""
+    add1 = base.make_op_batch(op=[orset.OP_ADD], key=[0], a0=[7], a1=[0], a2=[1])
+    origin = orset.apply_ops(orset.init(1, 8), add1)
+    rm = orset.prepare_ops(
+        origin, base.make_op_batch(op=[orset.OP_REMOVE], key=[0], a0=[7]))
+
+    x = orset.apply_ops(orset.apply_ops(orset.init(1, 8), add1), rm)
+    y = orset.apply_ops(orset.apply_ops(orset.init(1, 8), rm), add1)
+    assert not bool(orset.contains(x, 0, 7))
+    assert not bool(orset.contains(y, 0, 7))  # round-1 code failed here
+    merged = orset.merge(x, y)
+    assert not bool(orset.contains(merged, 0, 7))
+    # and the join itself agrees regardless of merge direction
+    m2 = orset.merge(y, x)
+    for f in merged:
+        np.testing.assert_array_equal(np.asarray(merged[f]), np.asarray(m2[f]))
 
 
 def test_safekv_concurrent_add_remove_no_divergence():

@@ -1,0 +1,137 @@
+"""Windowed-DAG long-run tests: the ring-buffered round window + GC must
+let the cluster tick indefinitely in bounded memory, preserving
+convergence and total-order-prefix equality far past the window depth
+(reference: DAG.GarbageCollect, DAG.cs:946-965 — rounds committed
+everywhere are collected; the reference's 100-round DAGTests :226-271 are
+the in-window analog).
+"""
+import time
+
+import numpy as np
+
+from janus_tpu.consensus import DagConfig, init, init_commit, round_step
+from janus_tpu.consensus import commit_view, ordered_blocks
+from janus_tpu.models import base, pncounter
+from janus_tpu.runtime.safecrdt import SafeKV
+
+N, W, B, K = 4, 8, 4, 8
+
+
+def pnc_ops(rng):
+    shape = (N, B)
+    return base.make_op_batch(
+        op=rng.integers(pncounter.OP_INC, pncounter.OP_DEC + 1, shape),
+        key=rng.integers(0, K, shape),
+        a0=rng.integers(1, 5, shape),
+        writer=np.broadcast_to(np.arange(N, dtype=np.int32)[:, None], shape),
+    )
+
+
+def make_kv(**kw):
+    return SafeKV(DagConfig(N, W), pncounter.SPEC, ops_per_block=B,
+                  num_keys=K, num_writers=N, **kw)
+
+
+def test_runs_ten_windows_with_gc():
+    """Tick 10x the window depth under continuous load: the GC frontier
+    must advance (bounded memory), every submit past the first window
+    must still be accepted, and convergence + total-order prefix
+    equality must hold throughout."""
+    kv = make_kv()
+    rng = np.random.default_rng(3)
+    accepted_all = True
+    prefix: list = []
+    for t in range(10 * W):
+        acc = kv.submit(pnc_ops(rng))
+        accepted_all = accepted_all and bool(acc.all())
+        kv.tick()
+        if t % 7 == 0:
+            o = kv.ordered_commits(0)
+            assert o[: len(prefix)] == prefix
+            prefix = o
+    assert accepted_all, "window back-pressure fired under steady load"
+    assert kv.base_round() > 5 * W, f"GC frontier stalled at {kv.base_round()}"
+    # rounds in the log exceed the window depth: the ring really wrapped
+    assert max(r for r, _ in kv.ordered_commits(0)) > 3 * W
+
+    for _ in range(6):
+        kv.tick()  # drain
+    stable = np.asarray(kv.query_stable("get"))
+    prosp = np.asarray(kv.query_prospective("get"))
+    assert (stable == stable[0]).all()
+    np.testing.assert_array_equal(stable, prosp)
+    orders = [kv.ordered_commits(v) for v in range(N)]
+    shortest = min(len(o) for o in orders)
+    assert shortest > 8 * W * N // 2
+    for o in orders:
+        assert o[:shortest] == orders[0][:shortest]
+
+
+def test_latency_history_survives_gc():
+    kv = make_kv()
+    rng = np.random.default_rng(4)
+    for _ in range(6 * W):
+        kv.submit(pnc_ops(rng), safe=np.ones((N, B), bool))
+        kv.tick()
+    lats = kv.commit_latencies()
+    # nearly every submitted block completed the safe path
+    assert lats.size > 4 * W * N
+    assert (lats >= 1).all() and np.median(lats) <= W
+
+
+def test_crash_recovery_state_transfer():
+    """A node that stays crashed across several windows is state-
+    transferred when it falls behind the GC frontier; after recovery the
+    cluster converges and its commit log matches the others — the
+    checkpoint/recovery capability the reference lacks (SURVEY §5)."""
+    import jax.numpy as jnp
+
+    kv = make_kv()
+    rng = np.random.default_rng(5)
+    crash = jnp.asarray([True, True, True, False])
+    for _ in range(4 * W):
+        ops = pnc_ops(rng)
+        # crashed node submits nothing
+        for f in ops:
+            ops[f] = ops[f].at[3].set(0) if hasattr(ops[f], "at") else ops[f]
+        kv.submit(ops)
+        kv.tick(active=crash)
+    assert kv.base_round() > W, "GC must advance past a crashed minority"
+    # recovery: full participation again
+    for _ in range(3 * W):
+        kv.submit(pnc_ops(rng))
+        kv.tick()
+    stable = np.asarray(kv.query_stable("get"))
+    assert (stable == stable[0]).all()
+    orders = [kv.ordered_commits(v) for v in range(N)]
+    shortest = min(len(o) for o in orders)
+    assert shortest > 0
+    for o in orders:
+        assert o[:shortest] == orders[0][:shortest]
+
+
+def test_commit_view_trace_scales():
+    """VERDICT weak-2 acceptance: the scan-based commit must trace and
+    run at production-shaped windows (N=16, W=64) in seconds, where the
+    round-1 Python-unrolled version emitted O(N*W^3) ops."""
+    cfg = DagConfig(16, 64)
+    st = init(cfg)
+    cst = init_commit(cfg)
+    t0 = time.perf_counter()
+    for _ in range(8):
+        st = round_step(cfg, st)
+    cst = commit_view(cfg, st, cst)
+    first = ordered_blocks(cfg, cst, 0)
+    dt = time.perf_counter() - t0
+    assert len(first) > 0
+    assert dt < 120, f"commit_view at N=16,W=64 took {dt:.1f}s"
+
+
+def test_dag_only_usage_stalls_at_window_edge():
+    """Without a GC driver (no commit state), the DAG back-pressures at
+    the window edge instead of corrupting slots."""
+    cfg = DagConfig(4, 8)
+    st = init(cfg)
+    for _ in range(20):
+        st = round_step(cfg, st)
+    assert (np.asarray(st["node_round"]) == cfg.num_rounds - 1).all()
