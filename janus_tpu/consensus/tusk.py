@@ -49,11 +49,16 @@ State = Dict[str, jnp.ndarray]
 
 def _mix32(x):
     """murmur3 finalizer on uint32 (public-domain constant schedule) —
-    the leader-election mix, identical on device and host."""
+    the leader-election mix, identical on device and host. The constants
+    exceed INT32_MAX, so they must be typed uint32: a bare Python-int
+    literal would be canonicalized to int32 by JAX and raise
+    OverflowError on every trace."""
+    c1 = x.dtype.type(0x85EBCA6B)
+    c2 = x.dtype.type(0xC2B2AE35)
     x = x ^ (x >> 16)
-    x = x * 0x85EBCA6B
+    x = x * c1
     x = x ^ (x >> 13)
-    x = x * 0xC2B2AE35
+    x = x * c2
     x = x ^ (x >> 16)
     return x
 
@@ -130,11 +135,25 @@ def _commit_one_view(cfg: DagConfig, edges, base, seed: int, steps: int,
     def wave_step(carry, _):
         com, seq, lw, ew, cnt = carry
         wv = ew + 1
-        complete = nr_v > 2 * wv + 1
+        # A wave is evaluable once the view is past its support round —
+        # or AT the support round already holding quorum certificates
+        # for it. The latter is the same information threshold as the
+        # reference's entry into round 2wv+2 (advancement requires 2f+1
+        # certs of 2wv+1, DAG.cs:629-714); without it, GC back-pressure
+        # pinning node_round at the support round would jam evaluation
+        # forever (bounded-ring liveness).
+        s_sup_c = (2 * wv + 1) % w
+        have_sup = jnp.sum(certs_v[s_sup_c])
+        complete = (nr_v > 2 * wv + 1) | (
+            (nr_v == 2 * wv + 1) & (have_sup >= cfg.quorum)
+        )
         l = leader_of(cfg, wv, seed)
         s_anchor = (2 * wv) % w
         anchor_ok = (
             complete
+            & (2 * wv >= base)  # anchor round still live: a lagging view
+            # must not read a recycled-and-refilled slot as the old
+            # wave's anchor (the back-chain has the same guard below)
             & certs_v[s_anchor, l]
             & _support(cfg, edges, seen_v, wv, l)
         )
@@ -145,20 +164,23 @@ def _commit_one_view(cfg: DagConfig, edges, base, seed: int, steps: int,
         # cert is held, it is uncommitted, and it is reachable from the
         # current chain head; the head then moves to it.
         def disc_step(dcarry, j):
-            head_r, head_src, alive = dcarry
+            head_r, head_src = dcarry
             wp = wv - 1 - j
             lp = leader_of(cfg, wp, seed)
             sp = (2 * wp) % w
+            # anchor_ok gates the whole chain (no anchor, no back-chain);
+            # leaders in wp > lw are provably uncommitted in com0, so no
+            # explicit stop-at-committed condition is needed here
             in_range = (wp > lw) & (2 * wp >= base)
-            cand_ok = alive & in_range & certs_v[sp, lp] & ~com0[sp, lp]
+            cand_ok = anchor_ok & in_range & certs_v[sp, lp] & ~com0[sp, lp]
             head_cl = _closure(cfg, edges, certs_v, com0, base, head_r, head_src)
             chained = cand_ok & head_cl[sp, lp]
             head_r = jnp.where(chained, 2 * wp, head_r)
             head_src = jnp.where(chained, lp, head_src)
-            return (head_r, head_src, alive), (chained, lp, wp)
+            return (head_r, head_src), (chained, lp, wp)
 
-        (_, _, _), (chained, lps, wps) = lax.scan(
-            disc_step, (2 * wv, l, anchor_ok), jnp.arange(lb)
+        (_, _), (chained, lps, wps) = lax.scan(
+            disc_step, (2 * wv, l), jnp.arange(lb)
         )
 
         # -- commit oldest-first (leaderStack pop order): each chained

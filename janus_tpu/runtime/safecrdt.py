@@ -37,13 +37,17 @@ tensor analog of the reference shipping state snapshots rather than
 operations. SafeKV refuses types that are neither replay-safe nor
 captured.
 
-Garbage collection: each tick the cluster-wide frontier advances past
-rounds that are (a) below every view's last committed anchor, (b)
-decided identically everywhere (committed sets equal, stable application
-complete, prospective application equal to the certificate set), and (c)
-structurally frozen (every node's round is past them). Their slots are
-cleared and handed to future rounds; blocks never certified/committed by
-then are abandoned, matching the reference's "assume they are already
+Garbage collection: each tick a QUORUM-based frontier advances past
+rounds that (a) can never gain a new commit (frozen per the quorum-th
+highest node round, wave evaluated by every quorum view, and no closure
+descent from above through uncommitted certificates — the no-descend-
+through-committed rule), and (b) are decided identically across the GC
+quorum (committed sets equal, stable application complete, prospective
+application equal to the certificate set). A crashed minority cannot
+freeze the frontier; a straggler view that missed a recycled slot is
+fenced by forced state transfer before it acts again. Slots are cleared
+and handed to future rounds; blocks never certified/committed by then
+are abandoned, matching the reference's "assume they are already
 persisted" GC comment. Total order and latency history survive GC in
 host-side logs.
 """
@@ -115,6 +119,9 @@ class SafeKV:
         self.buffer_filled = jnp.zeros((w, n), bool)
         self.prosp_applied = jnp.zeros((n, w, n), bool)
         self.stable_applied = jnp.zeros((n, w, n), bool)
+        # views flagged by last tick's GC as having missed a recycled
+        # slot — state-transferred at the start of the next tick
+        self.force_transfer = jnp.zeros((n,), bool)
         # host-side bookkeeping, all survives GC:
         #   submit/commit tick per live slot (op->serializable-commit
         #   latency), safe-op flags for deferred acks, the append-only
@@ -122,7 +129,11 @@ class SafeKV:
         self.submit_tick = np.full((w, n), -1, np.int64)
         self.commit_tick = np.full((w, n), -1, np.int64)
         self.safe_host = np.zeros((w, n, self.B), bool)
-        self.last_safe_acks = np.zeros((w, n, self.B), bool)
+        # safe acks accumulate here until the host drains them — a host
+        # polling less often than every tick must not lose acks
+        # (the reference tracks per-(client, seq) until notified,
+        # SafeCRDTManager.cs:108-160)
+        self.pending_safe_acks = np.zeros((w, n, self.B), bool)
         self.tick_count = 0
         self.latency_log: list[int] = []
         self.commit_log: list[list[tuple[int, int]]] = [[] for _ in range(n)]
@@ -147,6 +158,8 @@ class SafeKV:
         # the host resubmits on a False accept bit (DAG.cs:774-812).
         accepted = (~dag_state["block_exists"][s, vs]
                     & ~buffer_filled[s, vs]
+                    & (r >= dag_state["base_round"])  # straggler below the
+                    # frontier: its slot belongs to round r+W now
                     & (r < dag_state["base_round"] + cfg.num_rounds))  # [N]
         acc_ops = {
             f: jnp.where(accepted[:, None], ops[f], base.OP_NOOP if f == "op" else 0)
@@ -232,7 +245,7 @@ class SafeKV:
         return jax.vmap(one_view)(state, select, order_key)
 
     def _state_transfer(self, prospective, stable, dag_state, cstate,
-                        prosp_applied, stable_applied):
+                        prosp_applied, stable_applied, force):
         """Crash/lag recovery: a view that fell below the GC frontier or
         whose commit cursor lags the cluster beyond the repair window
         adopts a snapshot from the most-advanced view (the donor). This
@@ -246,8 +259,10 @@ class SafeKV:
         # quorum-th best view's commit cursor: the cluster's decided level
         lw_q = jnp.sort(lw)[cfg.num_nodes - cfg.quorum]
         lag_max = max(2, cfg.num_rounds // 4)
-        need = (dag_state["node_round"] < dag_state["base_round"]) | (
-            lw < lw_q - lag_max
+        need = (
+            (dag_state["node_round"] < dag_state["base_round"])
+            | (lw < lw_q - lag_max)
+            | force  # straggler missed a recycled slot last tick
         )  # [N]
         donor = jnp.argmax(lw)
 
@@ -271,10 +286,10 @@ class SafeKV:
         prosp_applied = adopt(prosp_applied)
         stable_applied = adopt(stable_applied)
         return (prospective, stable, dag_state, cstate, prosp_applied,
-                stable_applied, need)
+                stable_applied, need, donor)
 
     def _tick_device(self, prospective, stable, dag_state, cstate, ops_buffer,
-                     prosp_applied, stable_applied,
+                     buffer_filled, prosp_applied, stable_applied, force,
                      active: Optional[jnp.ndarray],
                      withhold: Optional[jnp.ndarray]):
         cfg = self.cfg
@@ -282,9 +297,9 @@ class SafeKV:
 
         # -- recovery first: transferred views join the current frontier
         (prospective, stable, dag_state, cstate, prosp_applied,
-         stable_applied, transferred) = self._state_transfer(
+         stable_applied, transferred, donor) = self._state_transfer(
             prospective, stable, dag_state, cstate, prosp_applied,
-            stable_applied)
+            stable_applied, force)
 
         dag_state = dagmod.round_step(cfg, dag_state, active, withhold)
 
@@ -309,44 +324,105 @@ class SafeKV:
         stable, stable_sel = self._delta_apply(stable, ops_buffer, pending, ckey)
         stable_applied = stable_applied | stable_sel
 
-        # -- GC: advance the frontier past rounds finished everywhere
+        # -- GC: advance the frontier past rounds finished by the GC
+        # quorum. The frontier is QUORUM-based, not unanimity-based (a
+        # crashed minority must not freeze GC — liveness under f faults
+        # is the point of 2f+1 quorums): views at or above the
+        # quorum-th-best commit cursor decide collectibility; a straggler
+        # view that was not done with a slot when it died has lost data
+        # it can never recover in-band, so it is flagged for state
+        # transfer at the start of the next tick (the reference's analog:
+        # lagging replicas self-repair via BlockQueryMessage within the
+        # retained window, DAG.cs:612-621 — past the window only a
+        # snapshot can help).
         if self.collect:
-            com = cstate["committed"]
-            com_consistent = jnp.all(com.all(0) == com.any(0), axis=-1)   # [W]
-            stable_done = jnp.all(stable_applied == com, axis=(0, 2))     # [W]
+            com = cstate["committed"]            # [N, W, N]
+            lw = cstate["last_wave"]             # [N]
+            big = jnp.iinfo(jnp.int32).max
+            lw_q = jnp.sort(lw)[n - cfg.quorum]
+            mask_q = lw >= lw_q                  # [N] the GC quorum
+            # reference decision per slot = union over the GC quorum;
+            # q_done then enforces every quorum view equals it exactly
+            mq = mask_q[:, None, None]
+            com_ref = jnp.any(jnp.where(mq, com, False), axis=0)      # [W, N]
+            com_ok = jnp.all(com == com_ref[None], axis=-1)           # [N, W]
+            st_ok = jnp.all(stable_applied == com_ref[None], axis=-1)  # [N, W]
             # prospective application must equal the certificate set —
             # except the origin's own pre-certification fast-path apply
             # of a block that never certified (allowed residue)
             diag = jnp.eye(n, dtype=bool)[:, None, :]                # [N,1,N]
             mism = prosp_applied != dag_state["cert_exists"][None]
             allowed = diag & prosp_applied & ~dag_state["cert_exists"][None]
-            prosp_done = jnp.all(~mism | allowed, axis=(0, 2))            # [W]
-            lw_min = jnp.min(cstate["last_wave"])
-            below_anchor = dag_state["slot_round"] < 2 * lw_min
-            frozen = dag_state["slot_round"] + 2 <= jnp.min(dag_state["node_round"])
-            collectible = (com_consistent & stable_done & prosp_done
-                           & below_anchor & frozen)
+            pr_ok = jnp.all(~mism | allowed, axis=-1)                 # [N, W]
+            view_done = com_ok & st_ok & pr_ok                        # [N, W]
+            q_done = jnp.all(view_done | ~mask_q[:, None], axis=0)    # [W]
+            # freeze point: the quorum-th-highest node round — a crashed
+            # minority's stalled round must not keep every slot warm
+            # (nodes below the threshold are fenced by state transfer
+            # before they act on recycled slots)
+            nr_q = jnp.sort(dag_state["node_round"])[n - cfg.quorum]
+            frozen = dag_state["slot_round"] + 2 <= nr_q
+            # A round is safe to collect only if it can never GAIN a new
+            # commit. New commits reach round r three ways: new blocks or
+            # certificates can still form there (not yet frozen — some
+            # quorum node's round is too close); a future anchor at r
+            # itself (r even, wave r//2 not yet evaluated by every quorum
+            # view); or closure descent from round r+1 passing through an
+            # uncommitted certificate there (the no-descend-through-
+            # committed rule, Consensus.cs:160,186) — the last two only
+            # matter while r still holds uncommitted certs. Scanned
+            # highest-round-first. This is sharper than "below the last
+            # anchor": a run of crashed-leader waves leaves rounds
+            # uncommitted ABOVE fully decided rounds, and collecting the
+            # decided ones is what lets the window slide so a live-leader
+            # wave can eventually evaluate and back-chain (the bounded-
+            # ring liveness analog of the reference's unbounded DAG).
+            # Liveness bound: W/2 waves must exceed the longest run of
+            # dead-leader waves + 2, else the ring deadlocks (the
+            # reference never deadlocks only because its DAG is
+            # unbounded in memory).
+            sr = dag_state["slot_round"]
+            base = dag_state["base_round"]
+            any_unc = jnp.any(dag_state["cert_exists"] & ~com_ref[..., :], axis=-1)  # [W]
+            ew_min_q = jnp.min(jnp.where(mask_q, cstate["eval_wave"], big))
+            direct = (sr % 2 == 0) & (sr // 2 > ew_min_q)             # [W]
+
+            def cg_body(i, carry):
+                can_above, can = carry
+                s = dagmod.slot_of(cfg, base + (w - 1 - i))
+                cg = ~frozen[s] | ((direct[s] | can_above) & any_unc[s])
+                return cg, can.at[s].set(cg)
+
+            _, can_gain = jax.lax.fori_loop(
+                0, w, cg_body, (jnp.asarray(True), jnp.zeros((w,), bool))
+            )
+            collectible = q_done & ~can_gain
             in_order = collectible[
                 dagmod.slot_of(cfg, dag_state["base_round"] + jnp.arange(w))
             ]
             adv = jnp.sum(jnp.cumprod(in_order.astype(jnp.int32)))
             new_base = dag_state["base_round"] + adv
             dead = dag_state["slot_round"] < new_base  # [W]
+            # straggler fence: any view not done with a dying slot must
+            # be state-transferred before it acts again
+            lost = jnp.any(dead[None, :] & ~view_done, axis=1)        # [N]
             dag_state = dagmod.recycle(cfg, dag_state, new_base)
             cstate = tusk.recycle_commit(cfg, cstate, new_base)
             ops_buffer = {
                 f: jnp.where(dead.reshape((w,) + (1,) * (v.ndim - 1)), 0, v)
                 for f, v in ops_buffer.items()
             }
+            buffer_filled = jnp.where(dead[:, None], False, buffer_filled)
             prosp_applied = jnp.where(dead[None, :, None], False, prosp_applied)
             stable_applied = jnp.where(dead[None, :, None], False, stable_applied)
             recycled = dead
         else:
             recycled = jnp.zeros((w,), bool)
+            lost = jnp.zeros((n,), bool)
 
         return (prospective, stable, dag_state, cstate, ops_buffer,
-                prosp_applied, stable_applied, fresh_com, seq_snap,
-                recycled, transferred)
+                buffer_filled, prosp_applied, stable_applied, fresh_com,
+                seq_snap, recycled, transferred, donor, lost)
 
     # -- host API ----------------------------------------------------------
 
@@ -375,21 +451,24 @@ class SafeKV:
         (slot-indexed; the safe-update completion signal: a node's safe
         ops are acked when its own block commits in its own view)."""
         (self.prospective, self.stable, self.dag, self.commit,
-         self.ops_buffer, self.prosp_applied, self.stable_applied,
-         fresh_com, seq_snap, recycled, transferred) = self._jit_tick(
+         self.ops_buffer, self.buffer_filled, self.prosp_applied,
+         self.stable_applied, fresh_com, seq_snap, recycled, transferred,
+         donor, lost) = self._jit_tick(
             self.prospective, self.stable, self.dag, self.commit,
-            self.ops_buffer, self.prosp_applied, self.stable_applied,
-            active, withhold)
+            self.ops_buffer, self.buffer_filled, self.prosp_applied,
+            self.stable_applied, self.force_transfer, active, withhold)
+        self.force_transfer = lost
         self.tick_count += 1
         fresh_com = np.asarray(fresh_com)
 
         # a transferred (crash-recovered) view adopts the donor's commit
-        # history wholesale — mirror that in the host-side log
+        # history wholesale — mirror that in the host-side log, from the
+        # SAME donor the device code used (argmax last_wave)
         trans = np.asarray(transferred)
         if trans.any():
-            donor = int(np.argmax([len(l) for l in self.commit_log]))
+            d = int(donor)
             for v in np.nonzero(trans)[0]:
-                self.commit_log[int(v)] = list(self.commit_log[donor])
+                self.commit_log[int(v)] = list(self.commit_log[d])
 
         # host bookkeeping: latency at own-view commit (the deferred
         # safe-update ack point, ClientInterface.cs:186-190), plus the
@@ -401,7 +480,7 @@ class SafeKV:
         self.latency_log.extend(
             (self.tick_count - self.submit_tick[newly]).tolist()
         )
-        self.last_safe_acks = newly[:, :, None] & self.safe_host
+        self.pending_safe_acks |= newly[:, :, None] & self.safe_host
 
         seqs = np.asarray(seq_snap)
         rounds = self._host_slot_round
@@ -423,11 +502,21 @@ class SafeKV:
         return fresh_com
 
     def safe_acks(self) -> np.ndarray:
-        """[W, N, B] mask of safe ops acked by the latest tick: the op's
-        block committed in its origin's own view (the deferred-reply
+        """[W, N, B] mask of safe ops acked since the last drain: the
+        op's block committed in its origin's own view (the deferred-reply
         signal the reference sends per client connection,
-        SafeCRDTManager.safeUpdateCompleteClientNotifier)."""
-        return self.last_safe_acks
+        SafeCRDTManager.safeUpdateCompleteClientNotifier). Accumulates
+        across ticks; call ``drain_safe_acks`` to consume. Hosts should
+        drain at least once per window (W ticks) — past that, a recycled
+        slot's undrained ack becomes indistinguishable from its
+        successor round's."""
+        return self.pending_safe_acks.copy()
+
+    def drain_safe_acks(self) -> np.ndarray:
+        """Return and clear the accumulated [W, N, B] safe-ack mask."""
+        acks = self.pending_safe_acks
+        self.pending_safe_acks = np.zeros_like(acks)
+        return acks
 
     def commit_latencies(self) -> np.ndarray:
         """Ticks from submit to stable commit in the origin's own view,

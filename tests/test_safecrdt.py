@@ -245,3 +245,23 @@ def test_safekv_concurrent_add_remove_no_divergence():
     assert (prosp == prosp[0]).all(), prosp
     assert (stable == stable[0]).all(), stable
     assert prosp[0]  # add-wins: the fresh re-add tag survives
+
+
+def test_safe_acks_accumulate_until_drained():
+    """Safe acks survive hosts that poll less often than every tick:
+    they accumulate across ticks and clear only on drain (the reference
+    tracks per-(client, seq) until the notifier fires,
+    SafeCRDTManager.cs:108-160)."""
+    kv = make_kv()
+    kv.submit(pnc_ops([[(0, 1)], [(1, 2)], [], []]),
+              safe=np.asarray([[True] + [False] * (B - 1),
+                               [True] + [False] * (B - 1),
+                               [False] * B, [False] * B]))
+    for _ in range(2 * W):      # no drain in between
+        kv.tick()
+    acks = kv.safe_acks()
+    assert acks.sum() == 2      # both safe ops acked, none lost
+    assert kv.safe_acks().sum() == 2   # peeking does not consume
+    drained = kv.drain_safe_acks()
+    np.testing.assert_array_equal(drained, acks)
+    assert kv.drain_safe_acks().sum() == 0   # drained clear
