@@ -1,0 +1,13 @@
+"""Client plane: native TCP server binding, wire client, and the
+JanusService composition root (reference: BFT-CRDT/Network/ +
+JanusService.cs)."""
+from janus_tpu.net.binding import (  # noqa: F401
+    NativeServer,
+    ecdsa_available,
+    ecdsa_keygen,
+    ecdsa_sign,
+    ecdsa_verify,
+    sha256,
+)
+from janus_tpu.net.client import JanusClient  # noqa: F401
+from janus_tpu.net.service import JanusConfig, JanusService, TypeConfig  # noqa: F401

@@ -1,0 +1,216 @@
+"""ctypes binding to the native host runtime (libjanus_native.so).
+
+The native side owns the wire boundary the reference implements in
+managed code — Base128 length-prefixed framing (CMNode.cs:81,
+ManagerServer.cs:99), the client-interface TCP server
+(Network/ClientInterface.cs:130-272), request batching + key/param
+interning (SafeCRDTManager.cs:164-198) — and the crypto primitives
+(SHA-256 block digests, Block.cs:45-73; ECDSA P-256 sign/verify,
+Replica.cs:34-42, Block.cs:75-88).
+
+The shared library is built on demand from the checked-in sources (build
+artifacts are not committed); the Makefile needs only g++.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libjanus_native.so"))
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> None:
+    srcs = [f for f in os.listdir(_NATIVE_DIR) if f.endswith(".cc")]
+    stale = not os.path.exists(_LIB_PATH) or any(
+        os.path.getmtime(os.path.join(_NATIVE_DIR, f))
+        > os.path.getmtime(_LIB_PATH)
+        for f in srcs + ["janus_native.h"]
+    )
+    if stale:
+        subprocess.run(
+            ["make", "-s", "-C", os.path.abspath(_NATIVE_DIR)], check=True
+        )
+
+
+def load() -> ctypes.CDLL:
+    """Build (if stale) and load the native library; idempotent."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        c = ctypes
+        u8p, i32p, i64p, u64p = (
+            c.POINTER(c.c_uint8), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int64), c.POINTER(c.c_uint64),
+        )
+        lib.janus_sha256.argtypes = [u8p, c.c_size_t, u8p]
+        lib.janus_ecdsa_available.restype = c.c_int
+        lib.janus_ecdsa_keygen.argtypes = [u8p, i32p, u8p, i32p]
+        lib.janus_ecdsa_sign.argtypes = [u8p, c.c_int, u8p, c.c_size_t, u8p, i32p]
+        lib.janus_ecdsa_verify.argtypes = [u8p, c.c_int, u8p, c.c_size_t, u8p, c.c_int]
+        lib.janus_server_create.restype = c.c_void_p
+        lib.janus_server_create.argtypes = [c.c_char_p, c.c_int, c.c_int]
+        for f in ("start", "port"):
+            getattr(lib, f"janus_server_{f}").argtypes = [c.c_void_p]
+            getattr(lib, f"janus_server_{f}").restype = c.c_int
+        for f in ("stop", "destroy"):
+            getattr(lib, f"janus_server_{f}").argtypes = [c.c_void_p]
+        lib.janus_server_register_type.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+        lib.janus_server_register_type.restype = c.c_int
+        lib.janus_server_poll_batch.argtypes = [
+            c.c_void_p, c.c_int, i32p, i32p, i32p, u8p, i64p, i64p, i64p, u64p,
+        ]
+        lib.janus_server_poll_batch.restype = c.c_int
+        lib.janus_server_key_count.argtypes = [c.c_void_p, c.c_int]
+        lib.janus_server_key_count.restype = c.c_int
+        lib.janus_server_reply.argtypes = [c.c_void_p, c.c_uint64, c.c_char_p,
+                                           c.c_char_p]
+        lib.janus_server_reply.restype = c.c_int
+        for f in ("ops_received", "replies_sent"):
+            getattr(lib, f"janus_server_{f}").argtypes = [c.c_void_p]
+            getattr(lib, f"janus_server_{f}").restype = c.c_longlong
+        _lib = lib
+        return lib
+
+
+def sha256(data: bytes) -> bytes:
+    lib = load()
+    out = (ctypes.c_uint8 * 32)()
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else None
+    lib.janus_sha256(buf, len(data), out)
+    return bytes(out)
+
+
+def ecdsa_available() -> bool:
+    return bool(load().janus_ecdsa_available())
+
+
+def ecdsa_keygen() -> Tuple[bytes, bytes]:
+    """(priv_der, pub_der); raises if libcrypto is unavailable."""
+    lib = load()
+    priv = (ctypes.c_uint8 * 512)()
+    pub = (ctypes.c_uint8 * 512)()
+    pl, ql = ctypes.c_int(512), ctypes.c_int(512)
+    rc = lib.janus_ecdsa_keygen(priv, ctypes.byref(pl), pub, ctypes.byref(ql))
+    if rc != 0:
+        raise RuntimeError(f"ecdsa_keygen failed ({rc})")
+    return bytes(priv[: pl.value]), bytes(pub[: ql.value])
+
+
+def ecdsa_sign(priv_der: bytes, msg: bytes) -> bytes:
+    lib = load()
+    sig = (ctypes.c_uint8 * 256)()
+    sl = ctypes.c_int(256)
+    p = (ctypes.c_uint8 * len(priv_der)).from_buffer_copy(priv_der)
+    m = (ctypes.c_uint8 * len(msg)).from_buffer_copy(msg) if msg else None
+    rc = lib.janus_ecdsa_sign(p, len(priv_der), m, len(msg), sig,
+                              ctypes.byref(sl))
+    if rc != 0:
+        raise RuntimeError(f"ecdsa_sign failed ({rc})")
+    return bytes(sig[: sl.value])
+
+
+def ecdsa_verify(pub_der: bytes, msg: bytes, sig: bytes) -> bool:
+    lib = load()
+    p = (ctypes.c_uint8 * len(pub_der)).from_buffer_copy(pub_der)
+    m = (ctypes.c_uint8 * len(msg)).from_buffer_copy(msg) if msg else None
+    s = (ctypes.c_uint8 * len(sig)).from_buffer_copy(sig)
+    return lib.janus_ecdsa_verify(p, len(pub_der), m, len(msg), s, len(sig)) == 0
+
+
+INTERN_BIT = 1 << 62  # non-numeric params come back interned (server.cc:44)
+
+
+class NativeServer:
+    """Owning wrapper over the native client-interface server."""
+
+    def __init__(self, bind_addr: str = "127.0.0.1", port: int = 0,
+                 max_clients: int = 64):
+        self._lib = load()
+        self._h = self._lib.janus_server_create(
+            bind_addr.encode(), port, max_clients
+        )
+        if not self._h:
+            raise RuntimeError("janus_server_create failed")
+        self._started = False
+
+    def start(self) -> int:
+        rc = self._lib.janus_server_start(self._h)
+        if rc != 0:
+            raise RuntimeError(f"janus_server_start failed ({rc})")
+        self._started = True
+        return self.port
+
+    @property
+    def port(self) -> int:
+        return self._lib.janus_server_port(self._h)
+
+    def register_type(self, type_code: str, key_capacity: int) -> int:
+        return self._lib.janus_server_register_type(
+            self._h, type_code.encode(), key_capacity
+        )
+
+    def poll_batch(self, cap: int):
+        """Drain up to ``cap`` parsed ops. Returns a dict of numpy arrays
+        (length = actual count): type_id, key_slot, op_code, is_safe,
+        p0..p2, client_tag."""
+        c = ctypes
+        tid = np.empty(cap, np.int32)
+        key = np.empty(cap, np.int32)
+        opc = np.empty(cap, np.int32)
+        safe = np.empty(cap, np.uint8)
+        p0 = np.empty(cap, np.int64)
+        p1 = np.empty(cap, np.int64)
+        p2 = np.empty(cap, np.int64)
+        tag = np.empty(cap, np.uint64)
+
+        def ptr(a, t):
+            return a.ctypes.data_as(c.POINTER(t))
+
+        n = self._lib.janus_server_poll_batch(
+            self._h, cap, ptr(tid, c.c_int32), ptr(key, c.c_int32),
+            ptr(opc, c.c_int32), ptr(safe, c.c_uint8), ptr(p0, c.c_int64),
+            ptr(p1, c.c_int64), ptr(p2, c.c_int64), ptr(tag, c.c_uint64),
+        )
+        return {
+            "type_id": tid[:n], "key_slot": key[:n], "op_code": opc[:n],
+            "is_safe": safe[:n], "p0": p0[:n], "p1": p1[:n], "p2": p2[:n],
+            "client_tag": tag[:n],
+        }
+
+    def key_count(self, type_id: int) -> int:
+        return self._lib.janus_server_key_count(self._h, type_id)
+
+    def reply(self, client_tag: int, result: str = "", response: str = "") -> int:
+        return self._lib.janus_server_reply(
+            self._h, ctypes.c_uint64(client_tag),
+            result.encode(), response.encode(),
+        )
+
+    def ops_received(self) -> int:
+        return self._lib.janus_server_ops_received(self._h)
+
+    def replies_sent(self) -> int:
+        return self._lib.janus_server_replies_sent(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.janus_server_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,203 @@
+"""Python client for the janus-tpu client plane.
+
+Speaks the framed ClientMessage schema the native server parses
+(server.cc:13-23): Base128 length-prefixed frames, each a varint/string
+field soup — the analog of the reference's protobuf client
+(BFT-CRDT-Client/ServerConnection.cs:30-111, CmdParser.cs:20-68).
+
+A request is ``(type_code, key, op_code, params, is_safe)``; the reply
+carries ``result``/``response`` strings and echoes the sequence number.
+``request`` blocks until the reply for its sequence arrives — for safe
+updates that is the deferred post-consensus ack, so the blocking call
+has exactly the reference's safe-update semantics
+(ClientInterface.cs:186-190, 233-241).
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, Iterable, Optional
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out.append(b | (0x80 if v else 0))
+        if not v:
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, off: int):
+    v = 0
+    for i in range(10):
+        if off >= len(buf):
+            return None, off
+        b = buf[off]
+        off += 1
+        v |= (b & 0x7F) << (7 * i)
+        if not (b & 0x80):
+            return v, off
+    raise ValueError("malformed varint")
+
+
+def encode_client_message(seq: int, key: str, type_code: str, op_code: str,
+                          params: Iterable[str] = (), is_safe: bool = False,
+                          source_type: int = 0) -> bytes:
+    """One ClientMessage payload (fields per server.cc:13-23)."""
+    out = bytearray()
+
+    def put_uint(field: int, v: int):
+        out.extend(_varint(field << 3 | 0))
+        out.extend(_varint(v))
+
+    def put_str(field: int, s: str):
+        b = s.encode()
+        out.extend(_varint(field << 3 | 2))
+        out.extend(_varint(len(b)))
+        out.extend(b)
+
+    put_uint(1, source_type)
+    put_uint(2, seq)
+    put_str(3, key)
+    put_str(4, type_code)
+    put_str(5, op_code)
+    put_uint(6, 1 if is_safe else 0)
+    for p in params:
+        put_str(7, str(p))
+    return bytes(out)
+
+
+def frame(payload: bytes, field: int = 1) -> bytes:
+    """Base128 length-prefix framing (framing.cc)."""
+    return _varint(field << 3 | 2) + _varint(len(payload)) + payload
+
+
+def decode_reply(payload: bytes) -> Dict[str, object]:
+    """Parse a reply frame: {seq, result, response}."""
+    out: Dict[str, object] = {"seq": None, "result": "", "response": ""}
+    off = 0
+    while off < len(payload):
+        tag, off = _read_varint(payload, off)
+        if tag is None:
+            break
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, off = _read_varint(payload, off)
+            if field == 2:
+                out["seq"] = v
+        elif wt == 2:
+            n, off = _read_varint(payload, off)
+            s = payload[off: off + n].decode(errors="replace")
+            off += n
+            if field == 8:
+                out["result"] = s
+            elif field == 9:
+                out["response"] = s
+        else:
+            break
+    return out
+
+
+class JanusClient:
+    """Blocking client over loopback/LAN TCP. Thread-safe sends; one
+    receive thread routes replies by sequence number."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.timeout = timeout
+        self._seq = 0
+        self._lock = threading.Lock()
+        # sends serialize on their own lock: sendall blocking on a full
+        # TCP buffer must never hold the lock the receive thread needs
+        # to deliver replies (full-duplex stall otherwise)
+        self._send_lock = threading.Lock()
+        self._replies: Dict[int, Dict[str, object]] = {}
+        self._cv = threading.Condition(self._lock)
+        self._closed = False
+        self._rx = threading.Thread(target=self._recv_loop, daemon=True)
+        self._rx.start()
+
+    # -- wire ------------------------------------------------------------
+
+    def _recv_loop(self):
+        buf = bytearray()
+        while not self._closed:
+            try:
+                chunk = self.sock.recv(65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf.extend(chunk)
+            while True:
+                parsed = self._try_frame(buf)
+                if parsed is None:
+                    break
+                with self._cv:
+                    if parsed["seq"] is not None:
+                        self._replies[int(parsed["seq"])] = parsed
+                        self._cv.notify_all()
+
+    @staticmethod
+    def _try_frame(buf: bytearray):
+        # parse in place (indexing works on bytearray) — copying the
+        # whole buffer per frame would be quadratic under reply backlog
+        tag, off = _read_varint(buf, 0)
+        if tag is None:
+            return None
+        n, off = _read_varint(buf, off)
+        if n is None or off + n > len(buf):
+            return None
+        payload = bytes(buf[off: off + n])
+        del buf[: off + n]
+        return decode_reply(payload)
+
+    # -- API -------------------------------------------------------------
+
+    def send(self, type_code: str, key: str, op_code: str,
+             params: Iterable[str] = (), is_safe: bool = False) -> int:
+        """Fire one request; returns its sequence number."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        msg = encode_client_message(seq, key, type_code, op_code, params,
+                                    is_safe)
+        with self._send_lock:
+            self.sock.sendall(frame(msg))
+        return seq
+
+    def wait(self, seq: int, timeout: Optional[float] = None) -> Dict[str, object]:
+        """Block until the reply for ``seq`` arrives."""
+        deadline = time.monotonic() + (timeout or self.timeout)
+        with self._cv:
+            while seq not in self._replies:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"no reply for seq {seq}")
+                self._cv.wait(remaining)
+            return self._replies.pop(seq)
+
+    def request(self, type_code: str, key: str, op_code: str,
+                params: Iterable[str] = (), is_safe: bool = False,
+                timeout: Optional[float] = None) -> Dict[str, object]:
+        """Send and block for the reply (deferred ack for safe updates)."""
+        return self.wait(self.send(type_code, key, op_code, params, is_safe),
+                         timeout)
+
+    def close(self):
+        self._closed = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,380 @@
+"""JanusService: the client-plane composition root.
+
+Reference: BFT-CRDT/JanusService.cs:36-101 composes config -> cluster ->
+DAG+Consensus -> managers -> ClientInterface; ClientInterface executes
+typed commands against the key space, replying immediately for reads and
+unsafe updates and deferring safe-update replies until consensus commits
+them (Network/ClientInterface.cs:192-272, 186-190;
+CRDTManagers/CRDTCommands/CommandController.cs:8-27).
+
+Here the native server (net/binding.py -> native/server.cc) owns the
+wire; this module owns dispatch: each ``step()`` drains the native op
+queue, executes reads/creates, rides updates on the emulated cluster's
+next blocks (SafeKV.submit), advances consensus one round (SafeKV.tick),
+and sends deferred acks for safe ops whose blocks committed. A client's
+ops land on its *home node* (connection id mod N) — the analog of the
+reference benchmark clients round-robining over servers
+(BenchmarkRunners.cs:106-124).
+
+Read-your-writes: reads are answered after the same step's submit+tick,
+so a connection's earlier updates (applied to its home node's
+prospective state at submit) are always visible — the reference gets
+this from per-connection serial execution (ClientInterface.cs:202-231).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from janus_tpu.consensus import DagConfig
+from janus_tpu.models import base
+from janus_tpu.net.binding import INTERN_BIT, NativeServer
+from janus_tpu.ops.lattice import SENTINEL
+from janus_tpu.runtime.safecrdt import SafeKV
+from janus_tpu.utils.ids import Interner, TagMinter
+
+# service-interned params (non-small-numeric) live above this bit so they
+# can never collide with literal numeric params
+_BIG = 1 << 30
+
+
+@dataclasses.dataclass(frozen=True)
+class TypeConfig:
+    type_code: str
+    dims: Dict[str, int]  # init dims, e.g. {"num_keys": 64, ...}
+
+    @property
+    def num_keys(self) -> int:
+        return int(self.dims["num_keys"])
+
+
+@dataclasses.dataclass(frozen=True)
+class JanusConfig:
+    """Runtime tunables (the ConfigParser + DAGOptions + clientBatchSize
+    analog, ConfigParser.cs:28-124, DAG.cs:25-32, JanusService.cs:28-29)."""
+
+    num_nodes: int = 4
+    window: int = 8
+    ops_per_block: int = 16
+    bind_addr: str = "127.0.0.1"
+    port: int = 0  # 0 -> ephemeral
+    max_clients: int = 64
+    types: Tuple[TypeConfig, ...] = (
+        TypeConfig("pnc", {"num_keys": 64}),
+        TypeConfig("orset", {"num_keys": 64, "capacity": 64}),
+    )
+
+    @classmethod
+    def from_json(cls, text: str) -> "JanusConfig":
+        raw = json.loads(text)
+        types = tuple(
+            TypeConfig(t["type_code"], {k: int(v) for k, v in t["dims"].items()})
+            for t in raw.get("types", [])
+        ) or cls.types
+        return cls(
+            num_nodes=int(raw.get("num_nodes", 4)),
+            window=int(raw.get("window", 8)),
+            ops_per_block=int(raw.get("ops_per_block", 16)),
+            bind_addr=raw.get("bind_addr", "127.0.0.1"),
+            port=int(raw.get("port", 0)),
+            max_clients=int(raw.get("max_clients", 64)),
+            types=types,
+        )
+
+
+class _TypeRuntime:
+    """One replicated type: its emulated SafeKV cluster + dispatch state."""
+
+    def __init__(self, cfg: JanusConfig, tcfg: TypeConfig):
+        spec = base.get_type(tcfg.type_code)
+        dims = dict(tcfg.dims)
+        if tcfg.type_code == "pnc":
+            dims.setdefault("num_writers", cfg.num_nodes)
+        self.spec = spec
+        self.kv = SafeKV(DagConfig(cfg.num_nodes, cfg.window), spec,
+                         ops_per_block=cfg.ops_per_block, **dims)
+        self.created: set = set()
+        self.minters = [TagMinter(v) for v in range(cfg.num_nodes)]
+        # per-home-node FIFO of (fields, client_tag, safe) awaiting a block
+        self.pending: List[deque] = [deque() for _ in range(cfg.num_nodes)]
+        # (slot, node, b) -> client_tag for deferred safe acks
+        self.ack_map: Dict[Tuple[int, int, int], int] = {}
+
+    # op-code letters for this type (e.g. {"i": 1, "d": 2})
+    def op_id(self, letters: str) -> Optional[int]:
+        return self.spec.op_codes.get(letters)
+
+
+def _letters(op_code: int) -> str:
+    s = chr(op_code & 0xFF)
+    hi = (op_code >> 8) & 0xFF
+    return s + (chr(hi) if hi else "")
+
+
+class JanusService:
+    """One process hosting the full emulated cluster + client plane."""
+
+    def __init__(self, cfg: JanusConfig = JanusConfig()):
+        self.cfg = cfg
+        self.server = NativeServer(cfg.bind_addr, cfg.port, cfg.max_clients)
+        self.types: Dict[int, _TypeRuntime] = {}
+        self._interner = Interner()
+        for tcfg in cfg.types:
+            tid = self.server.register_type(tcfg.type_code, tcfg.num_keys)
+            self.types[tid] = _TypeRuntime(cfg, tcfg)
+        self._stats_tid = self.server.register_type("stats", 1)
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self.ticks = 0
+        self._t0 = time.monotonic()
+        # reads waiting for their connection's earlier updates to board a
+        # block (read-your-writes): (tid, key, home, letters, tag, params)
+        self._deferred_reads: List[Tuple[int, int, int, str, int, Tuple[int, ...]]] = []
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self, pump: bool = True, interval: float = 0.0) -> int:
+        """Start the TCP server (returns its port) and, unless
+        ``pump=False``, a driver thread calling ``step`` continuously."""
+        port = self.server.start()
+        if pump:
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._pump, args=(interval,), daemon=True
+            )
+            self._thread.start()
+        return port
+
+    def _pump(self, interval: float):
+        while self._running:
+            try:
+                busy = self.step()
+            except Exception:  # noqa: BLE001 — driver thread must survive
+                # a poisoned request or transient device error must not
+                # silently kill the pump while the TCP server keeps
+                # accepting (clients would hang with zero diagnostics)
+                import traceback
+                traceback.print_exc()
+                busy = False
+            if not busy and interval >= 0:
+                time.sleep(max(interval, 0.001))
+
+    def stop(self):
+        self._running = False
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self.server.close()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- param/element mapping ------------------------------------------
+
+    def _elem_id(self, p: int) -> int:
+        """Map a wire param (numeric value, or native-interned id with
+        INTERN_BIT) to a device element id < SENTINEL. Small numerics map
+        to themselves; everything else interns above _BIG so literal and
+        interned values can never collide."""
+        if 0 <= p < _BIG:
+            return int(p)
+        eid = _BIG + self._interner.intern(int(p))
+        if eid >= int(SENTINEL):
+            raise OverflowError("element id space exhausted")
+        return eid
+
+    # -- dispatch --------------------------------------------------------
+
+    def step(self) -> bool:
+        """Drain the native queue, execute one protocol round, send
+        replies. Returns True if any client work was processed."""
+        cfg = self.cfg
+        n = cfg.num_nodes
+        polled = self.server.poll_batch(4096)
+        count = len(polled["client_tag"])
+        reads: List[Tuple[int, int, int, str, int]] = []  # tid,key,home,op,tag + params
+        read_params: List[Tuple[int, ...]] = []
+
+        for i in range(count):
+            tag = int(polled["client_tag"][i])
+            tid = int(polled["type_id"][i])
+            home = (tag >> 32) % n
+            letters = _letters(int(polled["op_code"][i]))
+            if tid == self._stats_tid:
+                self.server.reply(tag, self._stats_report(), "ok")
+                continue
+            rt = self.types.get(tid)
+            if rt is None:
+                self.server.reply(tag, "error: unknown type", "err")
+                continue
+            key = int(polled["key_slot"][i])
+            if letters == "s":
+                rt.created.add(key)
+                self.server.reply(tag, "success", "ok")
+                continue
+            if key not in rt.created:
+                self.server.reply(tag, "error: no such key", "err")
+                continue
+            if letters in ("gp", "gs"):
+                reads.append((tid, key, home, letters, tag))
+                read_params.append(tuple(int(p) for p in
+                                         (polled["p0"][i], polled["p1"][i])))
+                continue
+            op_id = rt.op_id(letters)
+            if op_id is None:
+                self.server.reply(tag, f"error: bad op {letters!r}", "err")
+                continue
+            fields = self._op_fields(rt, op_id, key, home, polled, i)
+            if fields is None:
+                self.server.reply(tag, "error: bad param", "err")
+                continue
+            safe = bool(polled["is_safe"][i])
+            rt.pending[home].append((fields, tag, safe))
+            if not safe:
+                # immediate reply for unsafe updates (the op is queued on
+                # the home node's next block; ClientInterface.cs:233-242)
+                self.server.reply(tag, "success", "ok")
+
+        # ride pending updates on each node's next block, advance one round
+        busy = count > 0
+        for rt in self.types.values():
+            busy |= self._submit_pending(rt)
+            rt.kv.tick()
+            self._send_safe_acks(rt)
+        self.ticks += 1
+
+        # answer reads post-tick, but only once every earlier update from
+        # the same connection has boarded a block (read-your-writes —
+        # an update still pending after a B-cap overflow or a sealed-slot
+        # requeue is not yet visible in any view, yet its client already
+        # holds a 'success' reply); unready reads retry next step
+        queue = self._deferred_reads + [
+            (tid, key, home, letters, tag, ps)
+            for (tid, key, home, letters, tag), ps in zip(reads, read_params)
+        ]
+        self._deferred_reads = []
+        for item in queue:
+            tid, key, home, letters, tag, ps = item
+            rt = self.types[tid]
+            if self._conn_has_pending(tag >> 32):
+                self._deferred_reads.append(item)
+                busy = True
+                continue
+            self.server.reply(tag, self._read(rt, key, home, letters, ps), "ok")
+        return busy
+
+    def _conn_has_pending(self, conn_id: int) -> bool:
+        return any(
+            (int(tag) >> 32) == conn_id
+            for rt in self.types.values()
+            for q in rt.pending
+            for (_, tag, _safe) in q
+        )
+
+    def _op_fields(self, rt: _TypeRuntime, op_id: int, key: int, home: int,
+                   polled, i: int) -> Optional[Dict[str, int]]:
+        """Wire op -> dense op record (the CRDTCommand.Execute analog,
+        PNCounterCommand.cs:12-79, ORSetCommand.cs:13-87). Returns None
+        for params the device schema cannot hold — the native parser
+        accepts any 18-digit int64 (server.cc:144-150), but op fields are
+        int32, and an unchecked assignment would raise inside step() and
+        take the whole service down with it."""
+        f = dict(op=op_id, key=key, a0=0, a1=0, a2=0, writer=home)
+        code = rt.spec.type_code
+        p0 = int(polled["p0"][i])
+        if code == "pnc":
+            # i/d amount; default 1 when the client sent no params
+            amt = int(p0) if p0 else 1
+            if not (0 <= amt < 2**31):
+                return None
+            f["a0"] = amt
+        elif code == "orset":
+            import janus_tpu.models.orset as orset_mod
+            if op_id in (orset_mod.OP_ADD, orset_mod.OP_REMOVE):
+                f["a0"] = self._elem_id(p0)
+            if op_id == orset_mod.OP_ADD:
+                rep, ctr = rt.minters[home].mint()
+                f["a1"], f["a2"] = rep, ctr
+        return f
+
+    def _submit_pending(self, rt: _TypeRuntime) -> bool:
+        cfg = self.cfg
+        n, B = cfg.num_nodes, cfg.ops_per_block
+        if not any(rt.pending):
+            return False
+        batch = {f: np.zeros((n, B), np.int32) for f in base.OP_FIELDS}
+        safe = np.zeros((n, B), bool)
+        placed: List[List[Tuple[int, bool, int]]] = [[] for _ in range(n)]
+        taken: List[List[Tuple[Dict[str, int], int, bool]]] = [[] for _ in range(n)]
+        for v in range(n):
+            b = 0
+            while rt.pending[v] and b < B:
+                fields, tag, is_safe = rt.pending[v].popleft()
+                taken[v].append((fields, tag, is_safe))
+                for name, val in fields.items():
+                    batch[name][v, b] = val
+                safe[v, b] = is_safe
+                placed[v].append((b, is_safe, tag))
+                b += 1
+        slots = np.asarray(rt.kv.dag["node_round"]) % cfg.window
+        accepted = rt.kv.submit(base.make_op_batch(**batch), safe=safe)
+        for v in range(n):
+            if accepted[v]:
+                for b, is_safe, tag in placed[v]:
+                    if is_safe:
+                        rt.ack_map[(int(slots[v]), v, b)] = tag
+            else:
+                # slot sealed/back-pressure: requeue in order for the
+                # next block (the reference re-queues uncertified
+                # updates, DAG.cs:774-812)
+                for item in reversed(taken[v]):
+                    rt.pending[v].appendleft(item)
+        return True
+
+    def _send_safe_acks(self, rt: _TypeRuntime):
+        if not rt.ack_map:
+            rt.kv.drain_safe_acks()
+            return
+        acks = rt.kv.drain_safe_acks()
+        for (s, v, b) in list(rt.ack_map):
+            if acks[s, v, b]:
+                tag = rt.ack_map.pop((s, v, b))
+                # deferred safe-update ack (NotifySafeUpdateComplete,
+                # ClientInterface.cs:186-190)
+                self.server.reply(tag, "success", "su")
+
+    def _read(self, rt: _TypeRuntime, key: int, home: int, letters: str,
+              params: Tuple[int, ...]) -> str:
+        q = rt.kv.query_prospective if letters == "gp" else rt.kv.query_stable
+        code = rt.spec.type_code
+        if code == "pnc":
+            vals = np.asarray(q("get"))  # [N, K]
+            return str(int(vals[home, key]))
+        if code == "orset":
+            elem = self._elem_id(params[0]) if params else 0
+            got = np.asarray(q("contains", key, elem))  # [N]
+            return "true" if bool(got[home]) else "false"
+        return "error: unreadable type"
+
+    def _stats_report(self) -> str:
+        """PerfCounter-style report (Utlis/PerfCounter.cs:13-88,
+        StatsCommand.cs:14-21)."""
+        dt = max(time.monotonic() - self._t0, 1e-9)
+        ops = self.server.ops_received()
+        return json.dumps({
+            "ops_received": ops,
+            "replies_sent": self.server.replies_sent(),
+            "ticks": self.ticks,
+            "uptime_sec": round(dt, 3),
+            "ops_per_sec": round(ops / dt, 1),
+        })

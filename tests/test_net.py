@@ -1,0 +1,151 @@
+"""Client-plane end-to-end tests: framed wire messages round-trip
+through the native TCP server into the consensus runtime and back.
+
+Reference analog: the full-system KVStoreTests (Tests/KVStoreTests.cs:
+16-365 — complete server stacks in one process driven over loopback
+TCP) and the safe-update blocking semantics test (:289-354).
+"""
+import numpy as np
+import pytest
+
+from janus_tpu.net import (
+    JanusClient,
+    JanusConfig,
+    JanusService,
+    TypeConfig,
+    ecdsa_available,
+    ecdsa_keygen,
+    ecdsa_sign,
+    ecdsa_verify,
+    sha256,
+)
+
+
+@pytest.fixture(scope="module")
+def service():
+    cfg = JanusConfig(
+        num_nodes=4, window=8, ops_per_block=8,
+        types=(TypeConfig("pnc", {"num_keys": 16}),
+               TypeConfig("orset", {"num_keys": 16, "capacity": 32})),
+    )
+    svc = JanusService(cfg)
+    port = svc.start()
+    yield svc, port
+    svc.stop()
+
+
+def test_native_sha256_known_vector():
+    assert sha256(b"abc").hex() == (
+        "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+    )
+
+
+def test_native_ecdsa_roundtrip():
+    if not ecdsa_available():
+        pytest.skip("libcrypto unavailable")
+    priv, pub = ecdsa_keygen()
+    sig = ecdsa_sign(priv, b"janus block digest")
+    assert ecdsa_verify(pub, b"janus block digest", sig)
+    assert not ecdsa_verify(pub, b"tampered", sig)
+
+
+def test_pnc_end_to_end(service):
+    svc, port = service
+    with JanusClient("127.0.0.1", port) as c:
+        assert c.request("pnc", "acct", "s")["result"] == "success"
+        assert c.request("pnc", "acct", "i", ["5"])["result"] == "success"
+        assert c.request("pnc", "acct", "i", ["2"])["result"] == "success"
+        # read-your-writes on the prospective path
+        assert c.request("pnc", "acct", "gp")["result"] == "7"
+
+
+def test_pnc_safe_update_deferred_ack(service):
+    svc, port = service
+    with JanusClient("127.0.0.1", port) as c:
+        c.request("pnc", "bank", "s")
+        r = c.request("pnc", "bank", "d", ["3"], is_safe=True, timeout=60)
+        # the reply only arrives after consensus committed the block
+        assert r["response"] == "su"
+        assert r["result"] == "success"
+        # the safe decrement is in the stable state
+        assert c.request("pnc", "bank", "gs", timeout=60)["result"] == "-3"
+
+
+def test_unknown_key_and_bad_op(service):
+    svc, port = service
+    with JanusClient("127.0.0.1", port) as c:
+        assert "error" in c.request("pnc", "ghost", "i", ["1"])["result"]
+        c.request("pnc", "k2", "s")
+        assert "error" in c.request("pnc", "k2", "zz")["result"]
+
+
+def test_orset_add_contains_remove(service):
+    svc, port = service
+    with JanusClient("127.0.0.1", port) as c:
+        c.request("orset", "tags", "s")
+        c.request("orset", "tags", "a", ["42"])
+        assert c.request("orset", "tags", "gp", ["42"])["result"] == "true"
+        # non-numeric elements go through the interner
+        c.request("orset", "tags", "a", ["hello"])
+        assert c.request("orset", "tags", "gp", ["hello"])["result"] == "true"
+        assert c.request("orset", "tags", "gp", ["absent"])["result"] == "false"
+        c.request("orset", "tags", "r", ["42"])
+        assert c.request("orset", "tags", "gp", ["42"])["result"] == "false"
+        # safe add: ack deferred until committed, then stably visible
+        r = c.request("orset", "tags", "a", ["77"], is_safe=True, timeout=60)
+        assert r["response"] == "su"
+        assert c.request("orset", "tags", "gs", ["77"], timeout=60)["result"] == "true"
+
+
+def test_stats_command(service):
+    svc, port = service
+    import json
+    with JanusClient("127.0.0.1", port) as c:
+        rep = json.loads(c.request("stats", "_", "g")["result"])
+        assert rep["ops_received"] > 0
+        assert rep["ticks"] > 0
+
+
+def test_multiple_clients_converge(service):
+    svc, port = service
+    with JanusClient("127.0.0.1", port) as a, JanusClient("127.0.0.1", port) as b:
+        a.request("pnc", "shared", "s")
+        b.request("pnc", "shared", "s")
+        for _ in range(5):
+            a.request("pnc", "shared", "i", ["1"])
+            b.request("pnc", "shared", "i", ["10"])
+        # both clients (different home nodes) converge on the total
+        deadline = 60
+        import time
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline:
+            va = int(a.request("pnc", "shared", "gp", timeout=60)["result"])
+            vb = int(b.request("pnc", "shared", "gp", timeout=60)["result"])
+            if va == vb == 55:
+                break
+            time.sleep(0.05)
+        assert va == vb == 55
+
+
+def test_oversized_param_rejected_not_fatal(service):
+    svc, port = service
+    with JanusClient("127.0.0.1", port) as c:
+        c.request("pnc", "big", "s")
+        r = c.request("pnc", "big", "i", [str(2**32)])
+        assert "error" in r["result"]
+        # service survives: normal traffic still works
+        assert c.request("pnc", "big", "i", ["1"])["result"] == "success"
+        assert c.request("pnc", "big", "gp", timeout=60)["result"] == "1"
+
+
+def test_read_your_writes_past_block_capacity(service):
+    # more pipelined updates than fit one block (ops_per_block=8): the
+    # read must still observe all of them (deferred until they board)
+    svc, port = service
+    with JanusClient("127.0.0.1", port) as c:
+        c.request("pnc", "ryw", "s")
+        seqs = [c.send("pnc", "ryw", "i", ["1"]) for _ in range(20)]
+        got = int(c.request("pnc", "ryw", "gp", timeout=60)["result"])
+        assert got == 20
+        for s in seqs:
+            c.wait(s, timeout=60)
