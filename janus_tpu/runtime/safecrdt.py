@@ -53,6 +53,7 @@ host-side logs.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 import jax
@@ -128,6 +129,11 @@ class SafeKV:
         #   per-view total-order log, and completed-latency history
         self.submit_tick = np.full((w, n), -1, np.int64)
         self.commit_tick = np.full((w, n), -1, np.int64)
+        # wall-clock submit stamps + completed latencies (seconds): the
+        # op->serializable-commit metric (BASELINE north star p99 <50ms;
+        # reference measures it client-side, Results.cs:96-232)
+        self.submit_wall = np.full((w, n), np.nan)
+        self.wall_latency_log: list[float] = []
         self.safe_host = np.zeros((w, n, self.B), bool)
         # safe acks accumulate here until the host drains them — a host
         # polling less often than every tick must not lose acks
@@ -135,12 +141,18 @@ class SafeKV:
         # SafeCRDTManager.cs:108-160)
         self.pending_safe_acks = np.zeros((w, n, self.B), bool)
         self.tick_count = 0
+        # latency histories are capped: a long-running service must not
+        # grow host memory without bound (oldest entries drop first)
+        self.max_latency_log = 200_000
         self.latency_log: list[int] = []
         self.commit_log: list[list[tuple[int, int]]] = [[] for _ in range(n)]
         self._host_slot_round = np.arange(w, dtype=np.int64)
 
         self._jit_submit = jax.jit(self._submit_device)
         self._jit_tick = jax.jit(self._tick_device)
+        self._jit_step = jax.jit(self._step_device)
+        # in-order absorb cursor for the split dispatch/absorb step path
+        self._absorb_tick = 0
 
     # -- device programs ---------------------------------------------------
 
@@ -424,7 +436,75 @@ class SafeKV:
                 buffer_filled, prosp_applied, stable_applied, fresh_com,
                 seq_snap, recycled, transferred, donor, lost)
 
+    def _step_device(self, prospective, stable, dag_state, cstate, ops_buffer,
+                     buffer_filled, prosp_applied, stable_applied, force,
+                     ops: base.OpBatch,
+                     active: Optional[jnp.ndarray],
+                     withhold: Optional[jnp.ndarray]):
+        """Fused submit+tick in ONE dispatch, with every host-needed
+        output packed into a single small int32 vector — on a
+        remote/tunneled backend each device->host fetch costs a full
+        network round trip, so the per-tick protocol must be one dispatch
+        plus one fetch, not six (the split submit/tick path costs ~6 RTTs
+        per round and dominates op->commit latency end to end)."""
+        cfg = self.cfg
+        n, w = cfg.num_nodes, cfg.num_rounds
+        pre_round = dag_state["node_round"]  # slot each batch boards
+        (prospective, ops_buffer, buffer_filled, prosp_applied,
+         accepted) = self._submit_device(
+            prospective, dag_state, ops_buffer, buffer_filled,
+            prosp_applied, ops)
+        (prospective, stable, dag_state, cstate, ops_buffer, buffer_filled,
+         prosp_applied, stable_applied, fresh_com, _seq_snap, recycled,
+         _transferred, _donor, lost) = self._tick_device(
+            prospective, stable, dag_state, cstate, ops_buffer,
+            buffer_filled, prosp_applied, stable_applied, force,
+            active, withhold)
+        vs = jnp.arange(n)
+        own = fresh_com[vs, :, vs]  # [N, W]: own-block commits per view
+        packed = jnp.concatenate([
+            pre_round.astype(jnp.int32),            # [N]
+            accepted.astype(jnp.int32),             # [N]
+            own.reshape(-1).astype(jnp.int32),      # [N*W]
+            recycled.astype(jnp.int32),             # [W]
+        ])
+        return (prospective, stable, dag_state, cstate, ops_buffer,
+                buffer_filled, prosp_applied, stable_applied, lost, packed)
+
     # -- host API ----------------------------------------------------------
+
+    def _absorb_commits(self, own: np.ndarray, rec: np.ndarray,
+                        tick_idx: int, now: float,
+                        update_rounds: bool) -> np.ndarray:
+        """Shared host bookkeeping for one completed tick — the split
+        tick() and fused step_absorb() paths must stay byte-identical
+        here (newly-committed detection, latency logs, safe acks,
+        recycled-slot resets). ``own`` is the [W, N] own-block commit
+        mask; ``rec`` the [W] recycled mask."""
+        newly = own & (self.submit_tick >= 0) & (self.commit_tick < 0)
+        self.commit_tick[newly] = tick_idx + 1
+        self.latency_log.extend(
+            (tick_idx + 1 - self.submit_tick[newly]).tolist()
+        )
+        if newly.any():
+            self.wall_latency_log.extend(
+                (now - self.submit_wall[newly]).tolist()
+            )
+        for log in (self.latency_log, self.wall_latency_log):
+            if len(log) > self.max_latency_log:
+                del log[: len(log) - self.max_latency_log]
+        self.pending_safe_acks |= newly[:, :, None] & self.safe_host
+        if rec.any():
+            self.submit_tick[rec] = -1
+            self.commit_tick[rec] = -1
+            self.submit_wall[rec] = np.nan
+            self.safe_host[rec] = False
+            if update_rounds:
+                # the step path never fetches slot_round; recycling adds
+                # exactly W to a slot's round, so mirror it incrementally
+                # (tick() refreshes from the device instead)
+                self._host_slot_round[rec] += self.cfg.num_rounds
+        return newly
 
     def submit(self, ops: base.OpBatch, safe: Optional[np.ndarray] = None) -> np.ndarray:
         """Buffer one [N, B] op batch (rides each node's next block) and
@@ -441,6 +521,7 @@ class SafeKV:
         acc = np.asarray(accepted)
         vs = np.arange(self.cfg.num_nodes)
         self.submit_tick[s[acc], vs[acc]] = self.tick_count
+        self.submit_wall[s[acc], vs[acc]] = time.perf_counter()
         if safe is not None:
             self.safe_host[s[acc], vs[acc]] = np.asarray(safe, bool)[acc]
         return acc
@@ -459,6 +540,7 @@ class SafeKV:
             self.stable_applied, self.force_transfer, active, withhold)
         self.force_transfer = lost
         self.tick_count += 1
+        self._absorb_tick = self.tick_count  # keep step_absorb cursor in sync
         fresh_com = np.asarray(fresh_com)
 
         # a transferred (crash-recovered) view adopts the donor's commit
@@ -475,13 +557,10 @@ class SafeKV:
         # append-only per-view total-order log (survives GC)
         vs = np.arange(self.cfg.num_nodes)
         own = fresh_com[vs, :, vs].T  # [W, N]
-        newly = own & (self.submit_tick >= 0) & (self.commit_tick < 0)
-        self.commit_tick[newly] = self.tick_count
-        self.latency_log.extend(
-            (self.tick_count - self.submit_tick[newly]).tolist()
-        )
-        self.pending_safe_acks |= newly[:, :, None] & self.safe_host
 
+        # the total-order log must translate slots through the PRE-recycle
+        # slot->round map (a slot can commit and be collected in the same
+        # tick), so it runs before _absorb_commits and the refresh below
         seqs = np.asarray(seq_snap)
         rounds = self._host_slot_round
         for v in range(self.cfg.num_nodes):
@@ -492,14 +571,87 @@ class SafeKV:
                     (int(rounds[ss[i]]), int(src[i])) for i in order
                 )
 
-        # recycled slots: reset host-side per-slot tracking
-        rec = np.asarray(recycled)
-        if rec.any():
-            self.submit_tick[rec] = -1
-            self.commit_tick[rec] = -1
-            self.safe_host[rec] = False
+        self._absorb_commits(own, np.asarray(recycled),
+                             self.tick_count - 1, time.perf_counter(),
+                             update_rounds=False)
         self._host_slot_round = np.asarray(self.dag["slot_round"]).astype(np.int64)
         return fresh_com
+
+    def step_dispatch(self, ops: base.OpBatch,
+                      safe: Optional[np.ndarray] = None,
+                      active=None, withhold=None, record=True):
+        """Fused submit+protocol-round in one async dispatch (no device
+        sync). Returns ``(packed, meta)``; pass both to ``step_absorb``
+        IN DISPATCH ORDER to complete host bookkeeping. A pipelined
+        driver keeps several fetches in flight so the backend round-trip
+        latency overlaps device compute — the remote-backend analog of
+        the reference's async per-peer sender channels (CMNode.cs:66-98).
+
+        This path skips the per-view commit log (``ordered_commits``)
+        — fetching the full commit tensors every tick costs extra round
+        trips; use submit()/tick() where the total order log matters.
+
+        ``record`` (bool or [N] bool mask) marks which nodes' blocks
+        carry real client payload this tick: unmarked blocks (idle keep-
+        alive rounds, drain phases) are excluded from latency logs and
+        latency stats so they cannot dilute the op->commit metric or grow
+        host memory at idle."""
+        (self.prospective, self.stable, self.dag, self.commit,
+         self.ops_buffer, self.buffer_filled, self.prosp_applied,
+         self.stable_applied, self.force_transfer, packed) = self._jit_step(
+            self.prospective, self.stable, self.dag, self.commit,
+            self.ops_buffer, self.buffer_filled, self.prosp_applied,
+            self.stable_applied, self.force_transfer, ops, active, withhold)
+        n = self.cfg.num_nodes
+        if record is True:
+            rec_mask = np.ones((n,), bool)
+        elif record is False:
+            rec_mask = np.zeros((n,), bool)
+        else:
+            rec_mask = np.asarray(record, bool)
+        meta = (time.perf_counter(), self.tick_count,
+                None if safe is None else np.asarray(safe, bool), rec_mask)
+        self.tick_count += 1
+        return packed, meta
+
+    def step_absorb(self, packed, meta, observed_at: float | None = None) -> dict:
+        """Complete bookkeeping for one dispatched step. ``packed`` may be
+        the device array (synchronizes here) or an already-fetched numpy
+        copy; ``observed_at`` is the wall time the fetch completed (for
+        honest client-observable commit latency under pipelining).
+        Returns {accepted[N], own[W,N], recycled[W], slot[N]}."""
+        stamp, tick_idx, safe, rec_mask = meta
+        if tick_idx != self._absorb_tick:
+            raise RuntimeError(
+                f"step_absorb out of order: got tick {tick_idx}, "
+                f"expected {self._absorb_tick}"
+            )
+        self._absorb_tick += 1
+        cfg = self.cfg
+        n, w = cfg.num_nodes, cfg.num_rounds
+        flat = np.asarray(packed)
+        pre_round = flat[:n]
+        acc = flat[n: 2 * n].astype(bool)
+        own = flat[2 * n: 2 * n + n * w].reshape(n, w).T.astype(bool)  # [W,N]
+        rec = flat[2 * n + n * w:].astype(bool)
+        now = observed_at if observed_at is not None else time.perf_counter()
+
+        s = pre_round % w
+        vs = np.arange(n)
+        st = acc & rec_mask  # only payload-bearing blocks enter the stats
+        self.submit_tick[s[st], vs[st]] = tick_idx
+        self.submit_wall[s[st], vs[st]] = stamp
+        if safe is not None:
+            self.safe_host[s[st], vs[st]] = safe[st]
+
+        self._absorb_commits(own, rec, tick_idx, now, update_rounds=True)
+        return {"accepted": acc, "own": own, "recycled": rec, "slot": s}
+
+    def step(self, ops: base.OpBatch, safe: Optional[np.ndarray] = None,
+             active=None, withhold=None, record=True) -> dict:
+        """Synchronous fused step: one dispatch + one fetch per round."""
+        packed, meta = self.step_dispatch(ops, safe, active, withhold, record)
+        return self.step_absorb(packed, meta)
 
     def safe_acks(self) -> np.ndarray:
         """[W, N, B] mask of safe ops acked since the last drain: the

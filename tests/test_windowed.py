@@ -135,3 +135,25 @@ def test_dag_only_usage_stalls_at_window_edge():
     for _ in range(20):
         st = round_step(cfg, st)
     assert (np.asarray(st["node_round"]) == cfg.num_rounds - 1).all()
+
+
+def test_fused_step_matches_submit_tick():
+    """The one-dispatch step() path must produce the same states and
+    latency bookkeeping as the split submit()+tick() path."""
+    kv_a, kv_b = make_kv(), make_kv()
+    rng_a, rng_b = np.random.default_rng(9), np.random.default_rng(9)
+    safe = np.ones((N, B), bool)
+    for _ in range(6 * W):
+        acc_a = kv_a.submit(pnc_ops(rng_a), safe=safe)
+        kv_a.tick()
+        info = kv_b.step(pnc_ops(rng_b), safe=safe)
+        np.testing.assert_array_equal(np.asarray(acc_a), info["accepted"])
+    sa = np.asarray(kv_a.query_stable("get"))
+    sb = np.asarray(kv_b.query_stable("get"))
+    np.testing.assert_array_equal(sa, sb)
+    pa = np.asarray(kv_a.query_prospective("get"))
+    pb = np.asarray(kv_b.query_prospective("get"))
+    np.testing.assert_array_equal(pa, pb)
+    np.testing.assert_array_equal(kv_a.commit_latencies(),
+                                  kv_b.commit_latencies())
+    np.testing.assert_array_equal(kv_a.safe_acks(), kv_b.safe_acks())
