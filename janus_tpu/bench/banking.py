@@ -1,0 +1,192 @@
+"""Banking benchmark: a realistic application over PN-Counter accounts,
+driven through the full client plane.
+
+Reference: BFT-CRDT-Client/BankingBenchmark — accounts are PN-Counters;
+ViewBalance = prospective read (gp), Deposit = increment (i),
+Transfer = SAFE decrement on the source then increment on the
+destination (chained after the safe ack), Withdraw = stable read (gs)
+then SAFE decrement; account access uniform or normal
+(BankingWorload.cs:14-260, BankingBenchmarkRunner.cs:20-227, access
+patterns :208-226, BankingBenchmarkResults.cs:12-110). The reference
+skips a server-side invariant check on Withdraw (BankingWorload.cs:
+186-190) — mirrored here: overdraft protection is the client-side
+stable read, not a server gate.
+
+Emits TPS + per-transaction-type latency stats.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from janus_tpu.bench.harness import OpStats
+
+
+@dataclasses.dataclass(frozen=True)
+class BankingConfig:
+    num_nodes: int = 4
+    window: int = 8
+    num_accounts: int = 100
+    clients: int = 4
+    txns_per_client: int = 100
+    ops_per_block: int = 128
+    # txn mix (reference default shape: mostly views/deposits, some
+    # transfers/withdrawals)
+    mix: Tuple[float, float, float, float] = (0.4, 0.3, 0.2, 0.1)
+    access: str = "uniform"  # uniform | normal
+    initial_balance: int = 1000
+    seed: int = 0
+
+    @classmethod
+    def from_json(cls, text: str) -> "BankingConfig":
+        raw = json.loads(text)
+        if "mix" in raw:
+            raw["mix"] = tuple(raw["mix"])
+        return cls(**raw)
+
+
+TXN_TYPES = ("view", "deposit", "transfer", "withdraw")
+
+
+class BankingResults:
+    def __init__(self, cfg: BankingConfig):
+        self.cfg = cfg
+        self.stats: Dict[str, OpStats] = {t: OpStats() for t in TXN_TYPES}
+        self.total_txns = 0
+        self.elapsed_s = 0.0
+        self.failed_withdrawals = 0
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "config": "banking",
+            "tps": round(self.total_txns / self.elapsed_s, 1)
+            if self.elapsed_s else 0.0,
+            "failed_withdrawals": self.failed_withdrawals,
+            "latency": {t: s.summary() for t, s in self.stats.items()},
+        }
+
+    def print_table(self) -> None:
+        d = self.to_dict()
+        print(f"== banking ({self.cfg.clients} clients x "
+              f"{self.cfg.txns_per_client} txns, {self.cfg.num_accounts} "
+              f"accounts, {self.cfg.access}) ==")
+        print(f"TPS: {d['tps']:,.1f}   failed withdrawals: "
+              f"{d['failed_withdrawals']}")
+        for t, s in d["latency"].items():
+            if s.get("count"):
+                print(f"  {t:>9}: n={s['count']:<6} median "
+                      f"{s['median_ms']:>8.2f} ms   p95 {s['p95_ms']:>8.2f}"
+                      f"   p99 {s['p99_ms']:>8.2f}")
+
+
+def _account(rng: np.random.Generator, cfg: BankingConfig) -> int:
+    if cfg.access == "normal":
+        raw = rng.normal(cfg.num_accounts / 2, cfg.num_accounts / 8)
+        return int(np.clip(raw, 0, cfg.num_accounts - 1))
+    return int(rng.integers(0, cfg.num_accounts))
+
+
+def run_banking(cfg: BankingConfig) -> BankingResults:
+    from janus_tpu.net import JanusClient, JanusConfig, JanusService, TypeConfig
+
+    res = BankingResults(cfg)
+    svc = JanusService(JanusConfig(
+        num_nodes=cfg.num_nodes, window=cfg.window,
+        ops_per_block=cfg.ops_per_block,
+        types=(TypeConfig("pnc", {"num_keys": cfg.num_accounts}),),
+    ))
+    port = svc.start()
+
+    # bootstrap: create accounts and seed balances
+    boot = JanusClient("127.0.0.1", port, timeout=120)
+    for a in range(cfg.num_accounts):
+        boot.request("pnc", f"acct{a}", "s")
+    seqs = [boot.send("pnc", f"acct{a}", "i", [str(cfg.initial_balance)])
+            for a in range(cfg.num_accounts)]
+    for s in seqs:
+        boot.wait(s, timeout=120)
+    boot.close()
+
+    lock = threading.Lock()
+    barrier = threading.Barrier(cfg.clients + 1)
+    w_view, w_dep, w_tr, w_wd = cfg.mix
+
+    def worker(wid: int):
+        rng = np.random.default_rng(cfg.seed + 1 + wid)
+        c = JanusClient("127.0.0.1", port, timeout=120)
+        local: List[Tuple[str, float]] = []
+        failed = 0
+        barrier.wait()
+        for _ in range(cfg.txns_per_client):
+            r = rng.random() * sum(cfg.mix)
+            src = f"acct{_account(rng, cfg)}"
+            amt = int(rng.integers(1, 100))
+            t1 = time.perf_counter()
+            if r < w_view:
+                c.request("pnc", src, "gp", timeout=120)
+                kind = "view"
+            elif r < w_view + w_dep:
+                c.request("pnc", src, "i", [str(amt)], timeout=120)
+                kind = "deposit"
+            elif r < w_view + w_dep + w_tr:
+                # transfer: SAFE debit source, then credit destination
+                # (the credit is chained after the consensus ack,
+                # BankingWorload.cs transfer callback chain)
+                dst = f"acct{_account(rng, cfg)}"
+                c.request("pnc", src, "d", [str(amt)], is_safe=True,
+                          timeout=120)
+                c.request("pnc", dst, "i", [str(amt)], timeout=120)
+                kind = "transfer"
+            else:
+                # withdraw: stable read, then safe debit if covered
+                bal = int(c.request("pnc", src, "gs", timeout=120)["result"])
+                if bal >= amt:
+                    c.request("pnc", src, "d", [str(amt)], is_safe=True,
+                              timeout=120)
+                else:
+                    failed += 1
+                kind = "withdraw"
+            local.append((kind, 1e3 * (time.perf_counter() - t1)))
+        c.close()
+        with lock:
+            for kind, ms in local:
+                res.stats[kind].latencies_ms.append(ms)
+            res.total_txns += len(local)
+            res.failed_withdrawals += failed
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(cfg.clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    res.elapsed_s = time.perf_counter() - t0
+    svc.stop()
+    return res
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", help="JSON BankingConfig file")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    cfg = (BankingConfig.from_json(open(args.config).read())
+           if args.config else BankingConfig())
+    res = run_banking(cfg)
+    if args.json:
+        print(json.dumps(res.to_dict()))
+    else:
+        res.print_table()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,390 @@
+"""Config-driven benchmark harness: load generation, latency split by op
+class, reference-style results table.
+
+Reference: BFT-CRDT-Client — BenchmarkConfig.cs:10-91 (JSON config:
+clients, duration, typeCode, numObjs, opsRatio[], safeRatio),
+BenchmarkRunners.cs:32-284 (N threads round-robin over servers,
+per-op send/recv timestamps), Results.cs:43-247 (latency split
+get/update/safeUpdate, mean/median/stdev/p95/p99, server throughput).
+
+Two drive modes:
+
+- ``wire``: closed-loop clients over loopback TCP through the full
+  client plane (native server -> JanusService -> SafeKV) — the
+  reference's own shape, end-to-end.
+- ``tensor``: direct SafeKV device loop with pipelined fetches — the
+  device-rate numbers (merge throughput, consensus commit latency)
+  without wire overhead; how the framework is driven when embedded.
+
+CLI: ``python -m janus_tpu.bench.harness --config cfg.json`` or
+``--preset pnc|orset|mixed|byzantine`` (BASELINE.json configs 1-4).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchConfig:
+    """BenchmarkConfig.cs analog (JSON-loadable)."""
+
+    name: str = "pnc_uniform"
+    mode: str = "tensor"              # "tensor" | "wire"
+    type_code: str = "pnc"            # pnc | orset | mixed
+    num_nodes: int = 4
+    window: int = 8
+    num_objects: int = 100
+    ops_per_block: int = 1000
+    ticks: int = 60
+    # wire mode
+    clients: int = 4
+    ops_per_client: int = 200
+    # op mix (BenchmarkConfig.opsRatio): weights by op class
+    ops_ratio: Tuple[float, float, float] = (0.5, 0.5, 0.0)  # get/update/safe
+    key_pattern: str = "uniform"      # uniform | zipf | normal
+    zipf_theta: float = 0.99
+    byzantine: int = 0                # nodes injecting invalid signatures
+    invalid_rate: float = 0.5
+    seed: int = 0
+
+    @classmethod
+    def from_json(cls, text: str) -> "BenchConfig":
+        raw = json.loads(text)
+        if "ops_ratio" in raw:
+            raw["ops_ratio"] = tuple(raw["ops_ratio"])
+        return cls(**raw)
+
+
+@dataclasses.dataclass
+class OpStats:
+    """One op class's latency population (Results.cs:96-232)."""
+
+    latencies_ms: List[float] = dataclasses.field(default_factory=list)
+
+    def summary(self) -> Dict[str, float]:
+        if not self.latencies_ms:
+            return {"count": 0}
+        a = np.asarray(self.latencies_ms)
+        return {
+            "count": int(a.size),
+            "mean_ms": round(float(a.mean()), 3),
+            "median_ms": round(float(np.median(a)), 3),
+            "stdev_ms": round(float(a.std()), 3),
+            "p95_ms": round(float(np.percentile(a, 95)), 3),
+            "p99_ms": round(float(np.percentile(a, 99)), 3),
+        }
+
+
+class Results:
+    """Aggregated run results + reference-table printer."""
+
+    # reference §6.2 numbers for side-by-side display (BASELINE.md)
+    REFERENCE = {
+        "pnc_peak_ops_per_sec": 260_000,
+        "orset_peak_ops_per_sec": 80_000,
+        "safe_latency_light_ms": "100-200",
+        "byzantine_throughput_delta": "-20%",
+    }
+
+    def __init__(self, cfg: BenchConfig):
+        self.cfg = cfg
+        self.stats: Dict[str, OpStats] = {
+            "get": OpStats(), "update": OpStats(), "safeUpdate": OpStats(),
+        }
+        self.total_ops = 0
+        self.elapsed_s = 0.0
+        self.extra: Dict[str, object] = {}
+
+    @property
+    def throughput(self) -> float:
+        return self.total_ops / self.elapsed_s if self.elapsed_s else 0.0
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "config": self.cfg.name,
+            "mode": self.cfg.mode,
+            "throughput_ops_per_sec": round(self.throughput, 1),
+            "latency": {k: v.summary() for k, v in self.stats.items()},
+            "reference": self.REFERENCE,
+            **self.extra,
+        }
+
+    def print_table(self) -> None:
+        d = self.to_dict()
+        print(f"== {self.cfg.name} ({self.cfg.mode}) ==")
+        print(f"throughput: {d['throughput_ops_per_sec']:>12,.1f} ops/s   "
+              f"(reference pnc peak {self.REFERENCE['pnc_peak_ops_per_sec']:,}, "
+              f"orset peak {self.REFERENCE['orset_peak_ops_per_sec']:,})")
+        for cls_, s in d["latency"].items():
+            if s.get("count"):
+                print(f"  {cls_:>11}: n={s['count']:<7} median "
+                      f"{s['median_ms']:>8.2f} ms   p95 {s['p95_ms']:>8.2f}"
+                      f"   p99 {s['p99_ms']:>8.2f}")
+        for k, v in self.extra.items():
+            print(f"  {k}: {v}")
+
+
+def _keys(rng: np.random.Generator, cfg: BenchConfig, shape) -> np.ndarray:
+    if cfg.key_pattern == "zipf":
+        from janus_tpu.bench.workloads import zipf_keys
+        return zipf_keys(rng, cfg.num_objects, shape, cfg.zipf_theta)
+    if cfg.key_pattern == "normal":
+        # normal access centered mid-keyspace (BankingBenchmarkRunner
+        # access patterns, :208-226)
+        raw = rng.normal(cfg.num_objects / 2, cfg.num_objects / 8, shape)
+        return np.clip(raw, 0, cfg.num_objects - 1).astype(np.int32)
+    return rng.integers(0, cfg.num_objects, shape).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# tensor mode
+# ---------------------------------------------------------------------------
+
+def run_tensor(cfg: BenchConfig) -> Results:
+    """Device-rate run: consensus path under steady load, with the safe
+    class measured by wall-clock submit->own-view-commit and queries
+    timed against the live state."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from janus_tpu.consensus import DagConfig
+    from janus_tpu.models import base, orset, pncounter
+    from janus_tpu.runtime.safecrdt import SafeKV
+    from janus_tpu.utils.ids import TagMinter
+
+    res = Results(cfg)
+    rng = np.random.default_rng(cfg.seed)
+    n, B, K = cfg.num_nodes, cfg.ops_per_block, cfg.num_objects
+    dag = DagConfig(cfg.num_nodes, cfg.window)
+
+    specs = []
+    if cfg.type_code in ("pnc", "mixed"):
+        specs.append(("pnc", SafeKV(dag, pncounter.SPEC, ops_per_block=B,
+                                    num_keys=K, num_writers=n)))
+    if cfg.type_code in ("orset", "mixed"):
+        specs.append(("orset", SafeKV(dag, orset.SPEC, ops_per_block=B,
+                                      num_keys=K, capacity=4 * K)))
+    minters = [TagMinter(v) for v in range(n)]
+
+    def gen_batch(code: str) -> dict:
+        shape = (n, B)
+        keys = _keys(rng, cfg, shape)
+        if code == "pnc":
+            op = rng.integers(pncounter.OP_INC, pncounter.OP_DEC + 1, shape)
+            return base.make_op_batch(
+                op=op.astype(np.int32), key=keys,
+                a0=rng.integers(1, 10, shape),
+                writer=np.broadcast_to(np.arange(n, dtype=np.int32)[:, None],
+                                       shape))
+        is_add = rng.random(shape) < 0.5
+        tags = np.zeros(shape + (2,), np.int32)
+        for v in range(n):
+            lanes = np.nonzero(is_add[v])[0]
+            if lanes.size:
+                tags[v, lanes] = minters[v].mint_many(lanes.size)
+        return base.make_op_batch(
+            op=np.where(is_add, orset.OP_ADD, orset.OP_REMOVE).astype(np.int32),
+            key=keys, a0=rng.integers(0, 64, shape),
+            a1=tags[..., 0], a2=tags[..., 1])
+
+    planes = {}
+    if cfg.byzantine:
+        from janus_tpu.consensus.integrity import IntegrityPlane, SecureCluster
+        byz = np.zeros(n, bool)
+        byz[-cfg.byzantine:] = True
+        specs = [(code, kv, SecureCluster(
+            kv, IntegrityPlane(dag, byzantine=byz,
+                               invalid_rate=cfg.invalid_rate, seed=cfg.seed)))
+            for code, kv in specs]
+        planes = {code: sc.plane for code, _, sc in specs}
+    else:
+        specs = [(code, kv, None) for code, kv in specs]
+
+    safe_frac = cfg.ops_ratio[2] / max(sum(cfg.ops_ratio[1:]), 1e-9)
+    safe = rng.random((n, B)) < safe_frac
+    batches = {code: [gen_batch(code) for _ in range(4)]
+               for code, _, _ in specs}
+
+    def fetch(packed):
+        return np.asarray(packed), time.perf_counter()
+
+    idle_batch = {code: {f: np.zeros_like(v)
+                         for f, v in batches[code][0].items()}
+                  for code, _, _ in specs}
+
+    def drive(pool, ticks, record=True, idle=False):
+        inflight = []
+        for i in range(ticks):
+            for code, kv, secure in specs:
+                batch = (idle_batch[code] if idle
+                         else batches[code][i % 4])
+                if secure is not None:
+                    secure.step(batch, safe=safe, record=record)
+                else:
+                    packed, meta = kv.step_dispatch(batch, safe=safe,
+                                                    record=record)
+                    inflight.append((kv, pool.submit(fetch, packed), meta))
+                    while len(inflight) > 8:
+                        k2, fut, m = inflight.pop(0)
+                        arr, at = fut.result()
+                        k2.step_absorb(arr, m, observed_at=at)
+        for k2, fut, m in inflight:
+            arr, at = fut.result()
+            k2.step_absorb(arr, m, observed_at=at)
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        drive(pool, 2 * cfg.window)  # warmup/compile
+        for _, kv, _ in specs:
+            kv.wall_latency_log.clear()
+            kv.latency_log.clear()
+        t0 = time.perf_counter()
+        drive(pool, cfg.ticks)
+        # submission-phase duration only: in steady state the sustained
+        # rate is the submission rate; the drain merely completes the
+        # tail so its latencies are recorded
+        res.elapsed_s = time.perf_counter() - t0
+        drive(pool, 2 * cfg.window, record=False, idle=True)  # drain
+
+    for code, kv, _ in specs:
+        lats = 1e3 * np.asarray(kv.wall_latency_log)
+        res.stats["safeUpdate"].latencies_ms.extend(lats.tolist())
+        res.total_ops += len(kv.latency_log) * B
+        # timed reads against the live state (the gp class)
+        for _ in range(10):
+            t1 = time.perf_counter()
+            q = "get" if code == "pnc" else "live_count"
+            np.asarray(kv.query_prospective(q))
+            res.stats["get"].latencies_ms.append(
+                1e3 * (time.perf_counter() - t1))
+    if planes:
+        res.extra["pruned_blocks"] = sum(
+            len(p.pruned_blocks()) for p in planes.values())
+    res.extra["commit_lag_ticks_p50"] = (
+        int(np.percentile(np.concatenate([
+            np.asarray(kv.latency_log) for _, kv, _ in specs]), 50)))
+    return res
+
+
+# ---------------------------------------------------------------------------
+# wire mode
+# ---------------------------------------------------------------------------
+
+def run_wire(cfg: BenchConfig) -> Results:
+    """Closed-loop clients over loopback TCP through the full plane
+    (BenchmarkRunners.cs shape: threads round-robin, barrier start,
+    per-op send/recv stamps)."""
+    from janus_tpu.net import JanusClient, JanusConfig, JanusService, TypeConfig
+
+    res = Results(cfg)
+    tcs = []
+    if cfg.type_code in ("pnc", "mixed"):
+        tcs.append(TypeConfig("pnc", {"num_keys": cfg.num_objects}))
+    if cfg.type_code in ("orset", "mixed"):
+        tcs.append(TypeConfig("orset", {"num_keys": cfg.num_objects,
+                                        "capacity": 4 * cfg.num_objects}))
+    svc = JanusService(JanusConfig(
+        num_nodes=cfg.num_nodes, window=cfg.window,
+        ops_per_block=max(64, cfg.ops_per_client // 4), types=tuple(tcs)))
+    port = svc.start()
+    lock = threading.Lock()
+    barrier = threading.Barrier(cfg.clients + 1)
+    get_w, upd_w, safe_w = cfg.ops_ratio
+
+    def worker(wid: int):
+        rng = np.random.default_rng(cfg.seed + wid)
+        c = JanusClient("127.0.0.1", port, timeout=120)
+        code = (cfg.type_code if cfg.type_code != "mixed"
+                else ("pnc" if wid % 2 == 0 else "orset"))
+        my_keys = [f"o{k}" for k in range(cfg.num_objects)]
+        for k in my_keys[:8]:  # create a working set
+            c.request(code, k, "s")
+        local: List[Tuple[str, float]] = []
+        barrier.wait()
+        for i in range(cfg.ops_per_client):
+            r = rng.random() * (get_w + upd_w + safe_w)
+            key = my_keys[int(_keys(rng, cfg, ())) % 8]
+            t1 = time.perf_counter()
+            if r < get_w:
+                c.request(code, key, "gp", ["1"] if code == "orset" else [])
+                cls_ = "get"
+            elif r < get_w + upd_w:
+                opc = "i" if code == "pnc" else "a"
+                c.request(code, key, opc, ["1"])
+                cls_ = "update"
+            else:
+                opc = "d" if code == "pnc" else "a"
+                c.request(code, key, opc, ["1"], is_safe=True)
+                cls_ = "safeUpdate"
+            local.append((cls_, 1e3 * (time.perf_counter() - t1)))
+        c.close()
+        with lock:
+            for cls_, ms in local:
+                res.stats[cls_].latencies_ms.append(ms)
+            res.total_ops += len(local)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(cfg.clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    res.elapsed_s = time.perf_counter() - t0
+    res.extra["server_stats"] = json.loads(
+        JanusClient("127.0.0.1", port).request("stats", "_", "g")["result"])
+    svc.stop()
+    return res
+
+
+PRESETS = {
+    # BASELINE.json configs 1-4 (config 5, RGA, lives with the sequence type)
+    "pnc": BenchConfig(name="pnc_4rep_banking_shape", type_code="pnc",
+                       num_nodes=4, num_objects=100, ops_ratio=(0.2, 0.6, 0.2)),
+    "orset": BenchConfig(name="orset_16rep", type_code="orset", num_nodes=16,
+                         window=8, num_objects=1000, ops_per_block=500,
+                         ops_ratio=(0.0, 1.0, 0.0)),
+    "mixed": BenchConfig(name="mixed_zipf_64rep", type_code="mixed",
+                         num_nodes=64, window=8, num_objects=1000,
+                         ops_per_block=256, key_pattern="zipf",
+                         ops_ratio=(0.3, 0.5, 0.2)),
+    "byzantine": BenchConfig(name="byzantine_orset", type_code="orset",
+                             num_nodes=16, num_objects=500, ops_per_block=256,
+                             byzantine=4, invalid_rate=0.25,
+                             ops_ratio=(0.0, 0.8, 0.2)),
+}
+
+
+def run(cfg: BenchConfig) -> Results:
+    return run_wire(cfg) if cfg.mode == "wire" else run_tensor(cfg)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", help="JSON BenchConfig file")
+    ap.add_argument("--preset", choices=sorted(PRESETS), help="named preset")
+    ap.add_argument("--mode", choices=("tensor", "wire"))
+    ap.add_argument("--json", action="store_true", help="emit JSON only")
+    args = ap.parse_args(argv)
+    if args.config:
+        cfg = BenchConfig.from_json(open(args.config).read())
+    else:
+        cfg = PRESETS[args.preset or "pnc"]
+    if args.mode:
+        cfg = dataclasses.replace(cfg, mode=args.mode)
+    res = run(cfg)
+    if args.json:
+        print(json.dumps(res.to_dict()))
+    else:
+        res.print_table()
+
+
+if __name__ == "__main__":
+    main()

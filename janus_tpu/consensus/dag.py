@@ -137,12 +137,19 @@ def structural_validity(cfg: DagConfig, state: State) -> jnp.ndarray:
     return (state["slot_round"][:, None] == 0) | (refs >= cfg.quorum)
 
 
-def sign_blocks(cfg: DagConfig, state: State, mask: Optional[jnp.ndarray] = None) -> State:
+def sign_blocks(cfg: DagConfig, state: State, mask: Optional[jnp.ndarray] = None,
+                invalid: Optional[jnp.ndarray] = None) -> State:
     """Every node acks each valid block it has seen; the signature is
     delivered to the block's creator where mask allows (mask axes:
-    [signer, round-slot, source])."""
+    [signer, round-slot, source]). ``invalid[W, N]`` marks blocks whose
+    host-side integrity verification failed (bad digest/signature) —
+    honest nodes refuse to ack them, so they can never certify (the
+    receive-side signature check of ReceivedBlock, DAG.cs:413-472; the
+    cryptography itself runs on host, consensus/integrity.py)."""
     m = _all_mask(cfg) if mask is None else mask
     valid = structural_validity(cfg, state)  # [W, N]
+    if invalid is not None:
+        valid = valid & ~invalid
     sigs = state["block_seen"] & valid[None] & m  # [signer, W, N]
     out = dict(state)
     out["acks"] = state["acks"] | jnp.transpose(sigs, (1, 2, 0))
@@ -219,12 +226,14 @@ def recycle(cfg: DagConfig, state: State, new_base) -> State:
 
 
 def round_step(cfg: DagConfig, state: State, active: Optional[jnp.ndarray] = None,
-               withhold: Optional[jnp.ndarray] = None) -> State:
+               withhold: Optional[jnp.ndarray] = None,
+               invalid: Optional[jnp.ndarray] = None) -> State:
     """One synchronous protocol round: create -> broadcast -> sign ->
     certify -> broadcast -> advance. With no masks this is the
     full-delivery fast path (the whole cluster moves one round per call);
     ``active``/``withhold`` model crashed and certificate-withholding
-    nodes. Crashed nodes neither create, sign, nor receive."""
+    nodes; ``invalid[W, N]`` marks integrity-failed blocks honest nodes
+    must not sign. Crashed nodes neither create, sign, nor receive."""
     act_mask = None
     wh = withhold
     if active is not None:
@@ -238,7 +247,7 @@ def round_step(cfg: DagConfig, state: State, active: Optional[jnp.ndarray] = Non
         wh = crash_wh if wh is None else (wh | crash_wh)
     state = create_blocks(cfg, state, active)
     state = deliver_blocks(cfg, state, act_mask)
-    state = sign_blocks(cfg, state, act_mask)
+    state = sign_blocks(cfg, state, act_mask, invalid)
     state = form_certificates(cfg, state, wh)
     state = deliver_certificates(cfg, state, act_mask)
     state = advance_rounds(cfg, state)

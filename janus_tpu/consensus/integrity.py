@@ -1,0 +1,263 @@
+"""Host-side block integrity plane: digests, signatures, verification,
+and invalid-block pruning for the emulated DAG.
+
+Reference: every VertexBlock carries a SHA-256 digest over
+round‖source‖prev-cert-hashes‖update-digests and an ECDSA P-256
+signature; receivers verify both before acking, and certificates are
+checked against the signer key table (DAGConsensus/Block.cs:45-88,
+Certificate.CheckSignatures :110-120, Replica keygen Replica.cs:34-42,
+committee key table Committee.cs:48-56); invalid blocks are pruned
+(DAG.PruneInvalidBlocks, DAG.cs:258-297), and the Byzantine experiment
+injects faulty behavior at a configurable rate
+(Tests/DAGTests.cs:1308-1453).
+
+TPU split (SURVEY §7): crypto never belongs on the accelerator — the
+device program carries boolean protocol state; digests/signing/verifying
+run host-side through the native library (net/binding.py -> sha256.cc /
+ecdsa.cc over libcrypto), overlapping with device compute. The host
+plane mirrors block creation each round, signs as each creator, verifies
+as the honest receivers, and emits the ``invalid[W, N]`` gate that
+``dag.sign_blocks`` applies — an invalid block is never acked by honest
+nodes, so it can never certify or commit; it dies in its slot and is
+recycled by GC (the pruning analog; ``pruned_blocks`` reports them).
+
+When libcrypto is unavailable the plane falls back to a keyed-hash
+scheme (sig = SHA-256(key‖digest) with per-replica secret keys): the
+protocol seam and every test stay identical, only the primitive weakens.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from janus_tpu.consensus.dag import DagConfig
+from janus_tpu.net import binding
+
+
+@dataclasses.dataclass
+class Replica:
+    """Per-node identity (Replica.cs:34-42). ``priv`` is DER for ECDSA
+    or a 32-byte secret for the keyed-hash fallback."""
+
+    node_id: int
+    priv: bytes
+    pub: bytes
+
+
+class Committee:
+    """Membership + verified public-key table (Committee.cs:11-57). In
+    the reference keys arrive via InitMessage broadcast at startup
+    (DAG.cs:142-145, 382-406); here the table is built at construction —
+    the same trust model (keys exchanged before round 1)."""
+
+    def __init__(self, replicas: List[Replica]):
+        self.replicas = replicas
+        self.keys: Dict[int, bytes] = {r.node_id: r.pub for r in replicas}
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+
+def generate_committee(n: int, seed: int = 0) -> Committee:
+    """ECDSA P-256 keypair per replica (GenerateReplicas analog,
+    Replica.cs:44-65); keyed-hash fallback without libcrypto."""
+    rng = np.random.default_rng(seed)
+    reps = []
+    use_ecdsa = binding.ecdsa_available()
+    for v in range(n):
+        if use_ecdsa:
+            priv, pub = binding.ecdsa_keygen()
+        else:
+            priv = rng.bytes(32)
+            pub = priv  # symmetric fallback: verifier recomputes the MAC
+        reps.append(Replica(v, priv, pub))
+    return Committee(reps)
+
+
+def _sign(priv: bytes, digest: bytes, use_ecdsa: bool) -> bytes:
+    if use_ecdsa:
+        return binding.ecdsa_sign(priv, digest)
+    return binding.sha256(priv + digest)
+
+
+def _verify(pub: bytes, digest: bytes, sig: bytes, use_ecdsa: bool) -> bool:
+    if use_ecdsa:
+        return binding.ecdsa_verify(pub, digest, sig)
+    return binding.sha256(pub + digest) == sig
+
+
+class IntegrityPlane:
+    """Mirrors device-side block creation with real digests/signatures.
+
+    Call ``round_created(dag_state_pre, ops_digests)`` right after
+    observing which blocks the device created this round (in the
+    synchronous emulation: every active node creates at its node_round),
+    then feed ``invalid_mask()`` into the next ``tick``/``step`` so
+    honest nodes never sign bad blocks.
+
+    Byzantine injection: nodes in ``byzantine`` sign a *tampered* digest
+    with probability ``invalid_rate`` — the signature does not match the
+    block content, verification fails everywhere honest (the 50%%-invalid
+    -certificate experiment, Tests/DAGTests.cs:1357; paper §6.2 Fig 11).
+    """
+
+    def __init__(self, cfg: DagConfig, committee: Optional[Committee] = None,
+                 byzantine: Optional[np.ndarray] = None,
+                 invalid_rate: float = 0.0, seed: int = 0):
+        self.cfg = cfg
+        self.committee = committee or generate_committee(cfg.num_nodes, seed)
+        self.use_ecdsa = binding.ecdsa_available()
+        self.byzantine = (np.zeros(cfg.num_nodes, bool)
+                          if byzantine is None else np.asarray(byzantine, bool))
+        self.invalid_rate = invalid_rate
+        self._rng = np.random.default_rng(seed + 1)
+        w, n = cfg.num_rounds, cfg.num_nodes
+        # slot-indexed mirrors of the live window. The gate is
+        # FAIL-CLOSED: a block the host never mirrored (e.g. created
+        # right after a device-side state transfer moved its creator's
+        # round, so the host prediction missed it) must not be acked —
+        # verification-by-default-open would let tampered content certify
+        # before the host catches up. An unmirrored honest block costs
+        # one dropped block per recovery event, never safety.
+        self._digest: Dict[Tuple[int, int], bytes] = {}   # (round, src)
+        self._sig: Dict[Tuple[int, int], bytes] = {}
+        self._invalid = np.zeros((w, n), bool)
+        self._mirrored = np.zeros((w, n), bool)
+        self._slot_round = np.arange(w, dtype=np.int64)
+        self.pruned: List[Tuple[int, int]] = []  # invalid (round, src) log
+        self.verified_ok = 0
+        self.verified_bad = 0
+
+    def block_digest(self, round_: int, source: int, prev_mask: np.ndarray,
+                     ops_digest: bytes) -> bytes:
+        """SHA-256 over round‖source‖prev-certificate-set‖payload digest
+        (ComputeDigest, Block.cs:45-73). ``prev_mask`` is the block's
+        edge row — in the tensor model the prev-cert *set* is the content
+        the hash must cover; the referenced certificates' own digests are
+        recoverable from it because (round-1, t) names a unique block."""
+        prev_digests = b"".join(
+            self._digest.get((round_ - 1, int(t)), b"\0" * 32)
+            for t in np.nonzero(prev_mask)[0]
+        )
+        body = (int(round_).to_bytes(8, "little")
+                + int(source).to_bytes(4, "little")
+                + np.asarray(prev_mask, np.uint8).tobytes()
+                + prev_digests + ops_digest)
+        return binding.sha256(body)
+
+    def round_created(self, rounds: np.ndarray, sources: np.ndarray,
+                      edges: np.ndarray,
+                      ops_digests: Optional[List[bytes]] = None) -> None:
+        """Digest + sign the blocks created this round. ``rounds``/
+        ``sources`` list the new blocks; ``edges[i]`` is block i's
+        prev-cert mask; ``ops_digests[i]`` its payload digest."""
+        cfg = self.cfg
+        for i in range(len(sources)):
+            r, s = int(rounds[i]), int(sources[i])
+            slot = r % cfg.num_rounds
+            if self._slot_round[slot] > r:
+                continue  # stale phantom: never clobber a newer round's flags
+            if self._slot_round[slot] < r:
+                # slot rolls forward to a new round: previous round's
+                # per-source flags are dead
+                self._invalid[slot] = False
+                self._mirrored[slot] = False
+                self._slot_round[slot] = r
+            if self._mirrored[slot, s]:
+                continue  # already mirrored (signatures are immutable)
+            od = ops_digests[i] if ops_digests is not None else b""
+            digest = self.block_digest(r, s, edges[i], od)
+            self._digest[(r, s)] = digest
+            signed = digest
+            if self.byzantine[s] and self._rng.random() < self.invalid_rate:
+                # tampered content: signature over something else
+                signed = binding.sha256(b"tampered" + digest)
+            sig = _sign(self.committee.replicas[s].priv, signed, self.use_ecdsa)
+            self._sig[(r, s)] = sig
+            # honest receivers verify sig against the block they received
+            ok = _verify(self.committee.keys[s], digest, sig, self.use_ecdsa)
+            self._mirrored[slot, s] = True
+            self._invalid[slot, s] = not ok
+            if ok:
+                self.verified_ok += 1
+            else:
+                self.verified_bad += 1
+                self.pruned.append((r, s))
+
+    def invalid_mask(self) -> np.ndarray:
+        """bool[W, N] gate for dag.sign_blocks: proven-invalid OR
+        never-mirrored blocks (fail-closed; irrelevant for slots with no
+        block, since signing is gated on block_seen anyway)."""
+        return self._invalid | ~self._mirrored
+
+    def recycle(self, recycled: np.ndarray) -> None:
+        """Drop mirrors for collected slots (pairs with dag.recycle)."""
+        rec = np.asarray(recycled, bool)
+        if not rec.any():
+            return
+        for slot in np.nonzero(rec)[0]:
+            r = int(self._slot_round[slot])
+            for s in range(self.cfg.num_nodes):
+                self._digest.pop((r, s), None)
+                self._sig.pop((r, s), None)
+            self._invalid[slot] = False
+            self._mirrored[slot] = False
+            self._slot_round[slot] = r + self.cfg.num_rounds
+
+    def pruned_blocks(self) -> List[Tuple[int, int]]:
+        """All blocks whose verification failed, (round, source) — the
+        PruneInvalidBlocks return (DAG.cs:258-297)."""
+        return list(self.pruned)
+
+
+class SecureCluster:
+    """SafeKV + IntegrityPlane glue: drives the emulated cluster with
+    real per-block digests/signatures and the honest-refusal gate.
+
+    The synchronous emulation creates one block per active node per tick
+    at its pre-tick node_round; the plane signs exactly those, and the
+    resulting invalid mask gates the SAME tick's signing phase (host
+    crypto runs while the previous fetch is in flight)."""
+
+    def __init__(self, kv, plane: IntegrityPlane):
+        self.kv = kv
+        self.plane = plane
+
+    def step(self, ops, safe=None, active=None, **kw):
+        # NOTE: this mirror reads node_round/block_exists/cert_seen/
+        # base_round from the device each step (4 fetches). On a tunneled
+        # backend that costs RTTs the fused step path avoids; under full
+        # delivery every one of these is host-predictable, so a
+        # no-fetch mirror is the known optimization when the secure path
+        # needs bench-grade latency.
+        kv, plane = self.kv, self.plane
+        cfg = kv.cfg
+        n = cfg.num_nodes
+        act = (np.ones(n, bool) if active is None
+               else np.asarray(active, bool))
+        pre_round = np.asarray(kv.dag["node_round"])
+        base = int(np.asarray(kv.dag["base_round"]))
+        exists = np.asarray(kv.dag["block_exists"])
+        prev_certs = np.asarray(kv.dag["cert_seen"])
+        # mirror exactly create_blocks' gate (dag.py in_window): skip
+        # stale stragglers below the frontier and back-pressured rounds —
+        # a phantom mirror at a wrong round must never touch live flags
+        creating = [
+            v for v in range(n)
+            if act[v]
+            and base <= pre_round[v] < base + cfg.num_rounds
+            and not exists[pre_round[v] % cfg.num_rounds, v]
+        ]
+        rounds = pre_round[creating]
+        edges = np.stack([
+            prev_certs[v, (pre_round[v] - 1) % cfg.num_rounds]
+            if pre_round[v] > 0 else np.zeros(n, bool)
+            for v in creating
+        ]) if creating else np.zeros((0, n), bool)
+        plane.round_created(rounds, np.asarray(creating), edges)
+        info = kv.step(ops, safe=safe, active=active,
+                       invalid=plane.invalid_mask(), **kw)
+        plane.recycle(info["recycled"])
+        return info

@@ -303,7 +303,8 @@ class SafeKV:
     def _tick_device(self, prospective, stable, dag_state, cstate, ops_buffer,
                      buffer_filled, prosp_applied, stable_applied, force,
                      active: Optional[jnp.ndarray],
-                     withhold: Optional[jnp.ndarray]):
+                     withhold: Optional[jnp.ndarray],
+                     invalid: Optional[jnp.ndarray] = None):
         cfg = self.cfg
         w, n = cfg.num_rounds, cfg.num_nodes
 
@@ -313,7 +314,8 @@ class SafeKV:
             prospective, stable, dag_state, cstate, prosp_applied,
             stable_applied, force)
 
-        dag_state = dagmod.round_step(cfg, dag_state, active, withhold)
+        dag_state = dagmod.round_step(cfg, dag_state, active, withhold,
+                                      invalid)
 
         # -- prospective: delta-apply newly certified, causally-ready blocks
         prosp_ready = self._causal_closure(dag_state, prosp_applied)
@@ -440,7 +442,8 @@ class SafeKV:
                      buffer_filled, prosp_applied, stable_applied, force,
                      ops: base.OpBatch,
                      active: Optional[jnp.ndarray],
-                     withhold: Optional[jnp.ndarray]):
+                     withhold: Optional[jnp.ndarray],
+                     invalid: Optional[jnp.ndarray] = None):
         """Fused submit+tick in ONE dispatch, with every host-needed
         output packed into a single small int32 vector — on a
         remote/tunneled backend each device->host fetch costs a full
@@ -459,7 +462,7 @@ class SafeKV:
          _transferred, _donor, lost) = self._tick_device(
             prospective, stable, dag_state, cstate, ops_buffer,
             buffer_filled, prosp_applied, stable_applied, force,
-            active, withhold)
+            active, withhold, invalid)
         vs = jnp.arange(n)
         own = fresh_com[vs, :, vs]  # [N, W]: own-block commits per view
         packed = jnp.concatenate([
@@ -526,7 +529,7 @@ class SafeKV:
             self.safe_host[s[acc], vs[acc]] = np.asarray(safe, bool)[acc]
         return acc
 
-    def tick(self, active=None, withhold=None) -> np.ndarray:
+    def tick(self, active=None, withhold=None, invalid=None) -> np.ndarray:
         """One protocol round + delta state application + GC. Returns the
         [N, W, N] mask of blocks newly committed per node view this tick
         (slot-indexed; the safe-update completion signal: a node's safe
@@ -537,7 +540,8 @@ class SafeKV:
          donor, lost) = self._jit_tick(
             self.prospective, self.stable, self.dag, self.commit,
             self.ops_buffer, self.buffer_filled, self.prosp_applied,
-            self.stable_applied, self.force_transfer, active, withhold)
+            self.stable_applied, self.force_transfer, active, withhold,
+            invalid)
         self.force_transfer = lost
         self.tick_count += 1
         self._absorb_tick = self.tick_count  # keep step_absorb cursor in sync
@@ -579,7 +583,8 @@ class SafeKV:
 
     def step_dispatch(self, ops: base.OpBatch,
                       safe: Optional[np.ndarray] = None,
-                      active=None, withhold=None, record=True):
+                      active=None, withhold=None, record=True,
+                      invalid=None):
         """Fused submit+protocol-round in one async dispatch (no device
         sync). Returns ``(packed, meta)``; pass both to ``step_absorb``
         IN DISPATCH ORDER to complete host bookkeeping. A pipelined
@@ -601,7 +606,8 @@ class SafeKV:
          self.stable_applied, self.force_transfer, packed) = self._jit_step(
             self.prospective, self.stable, self.dag, self.commit,
             self.ops_buffer, self.buffer_filled, self.prosp_applied,
-            self.stable_applied, self.force_transfer, ops, active, withhold)
+            self.stable_applied, self.force_transfer, ops, active, withhold,
+            invalid)
         n = self.cfg.num_nodes
         if record is True:
             rec_mask = np.ones((n,), bool)
@@ -648,9 +654,10 @@ class SafeKV:
         return {"accepted": acc, "own": own, "recycled": rec, "slot": s}
 
     def step(self, ops: base.OpBatch, safe: Optional[np.ndarray] = None,
-             active=None, withhold=None, record=True) -> dict:
+             active=None, withhold=None, record=True, invalid=None) -> dict:
         """Synchronous fused step: one dispatch + one fetch per round."""
-        packed, meta = self.step_dispatch(ops, safe, active, withhold, record)
+        packed, meta = self.step_dispatch(ops, safe, active, withhold, record,
+                                          invalid)
         return self.step_absorb(packed, meta)
 
     def safe_acks(self) -> np.ndarray:

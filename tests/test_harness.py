@@ -1,0 +1,56 @@
+"""Benchmark-harness smoke tests: tiny configs through both drive modes
+plus the banking app (reference: BenchmarkRunners/BankingBenchmark end to
+end with real clients, Tests/KVStoreTests.cs-style single-process)."""
+import numpy as np
+
+from janus_tpu.bench.banking import BankingConfig, run_banking
+from janus_tpu.bench.harness import PRESETS, BenchConfig, run_tensor, run_wire
+
+
+def test_tensor_mode_pnc_small():
+    cfg = BenchConfig(name="t", type_code="pnc", num_nodes=4, window=8,
+                      num_objects=16, ops_per_block=8, ticks=20,
+                      ops_ratio=(0.2, 0.4, 0.4))
+    res = run_tensor(cfg)
+    d = res.to_dict()
+    assert d["throughput_ops_per_sec"] > 0
+    assert d["latency"]["safeUpdate"]["count"] > 0
+    assert d["latency"]["get"]["count"] > 0
+
+
+def test_tensor_mode_byzantine_small():
+    cfg = BenchConfig(name="b", type_code="pnc", num_nodes=4, window=16,
+                      num_objects=16, ops_per_block=8, ticks=24,
+                      byzantine=1, invalid_rate=0.5,
+                      ops_ratio=(0.0, 0.5, 0.5))
+    res = run_tensor(cfg)
+    assert res.to_dict()["throughput_ops_per_sec"] > 0
+    assert res.extra["pruned_blocks"] > 0
+
+
+def test_wire_mode_small():
+    cfg = BenchConfig(name="w", mode="wire", type_code="pnc", num_nodes=4,
+                      window=8, num_objects=8, clients=2, ops_per_client=10,
+                      ops_ratio=(0.4, 0.4, 0.2))
+    res = run_wire(cfg)
+    d = res.to_dict()
+    assert res.total_ops == 20
+    assert d["latency"]["safeUpdate"]["count"] > 0
+    assert d["server_stats"]["ops_received"] > 0
+
+
+def test_banking_small():
+    cfg = BankingConfig(num_accounts=8, clients=2, txns_per_client=12,
+                        ops_per_block=16, initial_balance=500)
+    res = run_banking(cfg)
+    d = res.to_dict()
+    assert res.total_txns == 24
+    assert d["tps"] > 0
+    assert sum(s.get("count", 0) for s in d["latency"].values()) == 24
+
+
+def test_presets_loadable():
+    for name, cfg in PRESETS.items():
+        assert cfg.num_nodes >= 4, name
+        assert BenchConfig.from_json(
+            __import__("json").dumps({"name": name})).name == name

@@ -1,0 +1,110 @@
+"""Integrity-plane tests: real digests/signatures over emulated blocks,
+honest refusal to ack invalid blocks, Byzantine invalid-signature
+injection, and pruning.
+
+Reference analog: Block digest/sign/verify round trips
+(Tests/DAGBlockAndMsgTests.cs), FaultyDAGTests — a node emitting invalid
+certificates at 50%% keeps the cluster live and its bad blocks get
+pruned (Tests/DAGTests.cs:1308-1453, PruneInvalidBlocks DAG.cs:258-297).
+"""
+import numpy as np
+
+from janus_tpu.consensus import DagConfig
+from janus_tpu.consensus.integrity import (
+    IntegrityPlane,
+    SecureCluster,
+    generate_committee,
+)
+from janus_tpu.models import base, pncounter
+from janus_tpu.runtime.safecrdt import SafeKV
+
+N, W, B, K = 4, 16, 4, 8
+
+
+def pnc_ops(rng):
+    shape = (N, B)
+    return base.make_op_batch(
+        op=rng.integers(pncounter.OP_INC, pncounter.OP_DEC + 1, shape),
+        key=rng.integers(0, K, shape),
+        a0=rng.integers(1, 5, shape),
+        writer=np.broadcast_to(np.arange(N, dtype=np.int32)[:, None], shape))
+
+
+def make_secure(**plane_kw):
+    cfg = DagConfig(N, W)
+    kv = SafeKV(cfg, pncounter.SPEC, ops_per_block=B,
+                num_keys=K, num_writers=N)
+    plane = IntegrityPlane(cfg, **plane_kw)
+    return SecureCluster(kv, plane)
+
+
+def test_block_digest_covers_content():
+    cfg = DagConfig(N, W)
+    plane = IntegrityPlane(cfg)
+    prev = np.asarray([True, True, True, False])
+    d1 = plane.block_digest(5, 1, prev, b"payload")
+    assert d1 != plane.block_digest(5, 2, prev, b"payload")   # source
+    assert d1 != plane.block_digest(6, 1, prev, b"payload")   # round
+    assert d1 != plane.block_digest(5, 1, prev, b"other")     # payload
+    prev2 = np.asarray([True, False, True, False])
+    assert d1 != plane.block_digest(5, 1, prev2, b"payload")  # edges
+
+
+def test_honest_run_all_blocks_verify():
+    sc = make_secure()
+    rng = np.random.default_rng(0)
+    for _ in range(2 * W):
+        sc.step(pnc_ops(rng), safe=np.ones((N, B), bool))
+    assert sc.plane.verified_bad == 0
+    assert sc.plane.verified_ok >= 2 * W * N - N  # every created block
+    assert sc.plane.pruned_blocks() == []
+    idle = base.make_op_batch(op=np.zeros((N, B), np.int32))
+    for _ in range(8):  # drain in-flight blocks
+        sc.step(idle, record=False)
+    stable = np.asarray(sc.kv.query_stable("get"))
+    prosp = np.asarray(sc.kv.query_prospective("get"))
+    assert (stable == stable[0]).all()
+    np.testing.assert_array_equal(stable, prosp)
+
+
+def test_byzantine_invalid_signatures_pruned_liveness_kept():
+    """Node 3 signs tampered digests half the time: the cluster stays
+    live, every pruned block is node 3's, the prune count tracks the
+    faulty rate, and honest nodes converge identically — node 3's
+    invalid blocks contribute nothing to any honest state."""
+    sc = make_secure(byzantine=np.asarray([False, False, False, True]),
+                     invalid_rate=0.5, seed=7)
+    rng = np.random.default_rng(1)
+    ticks = 4 * W
+    for _ in range(ticks):
+        sc.step(pnc_ops(rng))
+    # liveness: rounds and the GC frontier keep advancing
+    assert int(np.asarray(sc.kv.dag["node_round"]).min()) > ticks // 2
+    assert sc.kv.base_round() > W
+
+    pruned = sc.plane.pruned_blocks()
+    assert pruned, "no invalid blocks detected"
+    assert all(src == 3 for _, src in pruned)
+    # ~half of node 3's blocks invalid (binomial; generous bounds)
+    frac = len(pruned) / ticks
+    assert 0.25 < frac < 0.75, frac
+
+    # drain and check honest convergence
+    idle = base.make_op_batch(op=np.zeros((N, B), np.int32))
+    for _ in range(2 * W):
+        sc.step(idle, record=False)
+    stable = np.asarray(sc.kv.query_stable("get"))
+    prosp = np.asarray(sc.kv.query_prospective("get"))
+    honest = [0, 1, 2]
+    for v in honest[1:]:
+        np.testing.assert_array_equal(stable[0], stable[v])
+        np.testing.assert_array_equal(prosp[0], prosp[v])
+    np.testing.assert_array_equal(stable[honest][0], prosp[honest][0])
+
+
+def test_committee_key_table():
+    com = generate_committee(4, seed=3)
+    assert len(com) == 4
+    assert set(com.keys) == {0, 1, 2, 3}
+    # distinct identities
+    assert len({r.pub for r in com.replicas}) == 4
