@@ -71,6 +71,7 @@ def consensus_bench() -> dict:
 
     rng = np.random.default_rng(1)
     kv = SafeKV(DagConfig(CN, CW), pncounter.SPEC, ops_per_block=CB,
+                collect_logs=False,  # pure throughput: skip commit-log fetch
                 num_keys=CK, num_writers=CN)
     # pre-upload rotating batches: repeated host->device payload uploads
     # would ride every dispatch otherwise

@@ -163,11 +163,15 @@ def run_tensor(cfg: BenchConfig) -> Results:
     dag = DagConfig(cfg.num_nodes, cfg.window)
 
     specs = []
+    # collect_logs=False: these runs never read the total-order log,
+    # so skip the O(N^2*W) commit-tensor fetch per tick
     if cfg.type_code in ("pnc", "mixed"):
         specs.append(("pnc", SafeKV(dag, pncounter.SPEC, ops_per_block=B,
+                                    collect_logs=False,
                                     num_keys=K, num_writers=n)))
     if cfg.type_code in ("orset", "mixed"):
         specs.append(("orset", SafeKV(dag, orset.SPEC, ops_per_block=B,
+                                      collect_logs=False,
                                       num_keys=K, capacity=4 * K)))
     minters = [TagMinter(v) for v in range(n)]
 

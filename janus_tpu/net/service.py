@@ -36,8 +36,10 @@ from janus_tpu.consensus import DagConfig
 from janus_tpu.models import base
 from janus_tpu.net.binding import INTERN_BIT, NativeServer
 from janus_tpu.ops.lattice import SENTINEL
+from janus_tpu.runtime.keyspace import ReplicatedKeySpace
 from janus_tpu.runtime.safecrdt import SafeKV
 from janus_tpu.utils.ids import Interner, TagMinter
+from janus_tpu.utils.perf import PerfCounter
 
 # service-interned params (non-small-numeric) live above this bit so they
 # can never collide with literal numeric params
@@ -99,9 +101,17 @@ class _TypeRuntime:
         self.spec = spec
         self.kv = SafeKV(DagConfig(cfg.num_nodes, cfg.window), spec,
                          ops_per_block=cfg.ops_per_block, **dims)
-        self.created: set = set()
+        # consensus-ordered key space: creates ride DAG blocks, every
+        # view materializes (key -> slot) in committed total order
+        # (KeySpaceManager.cs:55-113, 151-177)
+        self.capacity = tcfg.num_keys
+        self.rks = ReplicatedKeySpace(cfg.num_nodes, tcfg.num_keys)
+        self.known_keys: set = set()      # creates ever seen (any state)
+        # wire key -> [(client_tag, home)] awaiting create materialization
+        self.create_tags: Dict[int, List[Tuple[int, int]]] = {}
         self.minters = [TagMinter(v) for v in range(cfg.num_nodes)]
-        # per-home-node FIFO of (fields, client_tag, safe) awaiting a block
+        # per-home-node FIFO of (fields, client_tag, safe, create_key)
+        # awaiting a block; create items carry fields=None
         self.pending: List[deque] = [deque() for _ in range(cfg.num_nodes)]
         # (slot, node, b) -> client_tag for deferred safe acks
         self.ack_map: Dict[Tuple[int, int, int], int] = {}
@@ -112,6 +122,18 @@ class _TypeRuntime:
     # op-code letters for this type (e.g. {"i": 1, "d": 2})
     def op_id(self, letters: str) -> Optional[int]:
         return self.spec.op_codes.get(letters)
+
+    def stats_snapshot(self) -> Dict[str, object]:
+        """DAGStats-style snapshot for the stats command."""
+        lat = self.kv.commit_latencies()
+        return {
+            **self.kv.stats,
+            "keys": len(self.rks.tables[0]),
+            "base_round": self.kv.base_round(),
+            "commit_lag_ticks_p50":
+                float(np.percentile(lat, 50)) if lat.size else None,
+            "pending_ops": sum(len(q) for q in self.pending),
+        }
 
 
 def _letters(op_code: int) -> str:
@@ -136,9 +158,17 @@ class JanusService:
         self._running = False
         self.ticks = 0
         self._t0 = time.monotonic()
-        # reads waiting for their connection's earlier updates to board a
-        # block (read-your-writes): (tid, key, home, letters, tag, params)
-        self._deferred_reads: List[Tuple[int, int, int, str, int, Tuple[int, ...]]] = []
+        # ops counted at reply time (PerfCounter.cs:13-88 — the
+        # reference hooks OpAdd on every client reply), plus step timing
+        self.perf = PerfCounter()
+        self._step_ms: List[float] = []
+        # reads waiting for their connection's earlier updates to board
+        # a block (read-your-writes) or for their key's create to commit
+        self._deferred_reads: List[dict] = []
+        # updates waiting for their key's create to commit in their
+        # home view (creates are serializable: slot assignment needs the
+        # committed total order)
+        self._waiting: List[dict] = []
 
     # -- lifecycle -------------------------------------------------------
 
@@ -201,99 +231,139 @@ class JanusService:
     def step(self) -> bool:
         """Drain the native queue, execute one protocol round, send
         replies. Returns True if any client work was processed."""
-        cfg = self.cfg
-        n = cfg.num_nodes
+        n = self.cfg.num_nodes
+        t_step = time.perf_counter()
         polled = self.server.poll_batch(4096)
         count = len(polled["client_tag"])
-        reads: List[Tuple[int, int, int, str, int]] = []  # tid,key,home,op,tag + params
-        read_params: List[Tuple[int, ...]] = []
-
+        if count:
+            self.perf.add(count)
+        items = self._waiting
+        self._waiting = []
         for i in range(count):
-            tag = int(polled["client_tag"][i])
-            tid = int(polled["type_id"][i])
-            home = (tag >> 32) % n
-            letters = _letters(int(polled["op_code"][i]))
-            if tid == self._stats_tid:
-                self.server.reply(tag, self._stats_report(), "ok")
-                continue
-            rt = self.types.get(tid)
-            if rt is None:
-                self.server.reply(tag, "error: unknown type", "err")
-                continue
-            key = int(polled["key_slot"][i])
-            if letters == "s":
-                rt.created.add(key)
-                self.server.reply(tag, "success", "ok")
-                continue
-            if key not in rt.created:
-                self.server.reply(tag, "error: no such key", "err")
-                continue
-            if letters in ("gp", "gs"):
-                reads.append((tid, key, home, letters, tag))
-                read_params.append(tuple(int(p) for p in
-                                         (polled["p0"][i], polled["p1"][i])))
-                continue
-            op_id = rt.op_id(letters)
-            if op_id is None:
-                self.server.reply(tag, f"error: bad op {letters!r}", "err")
-                continue
-            fields = self._op_fields(rt, op_id, key, home, polled, i)
-            if fields is None:
-                self.server.reply(tag, "error: bad param", "err")
-                continue
-            safe = bool(polled["is_safe"][i])
-            rt.pending[home].append((fields, tag, safe))
-            if not safe:
-                # immediate reply for unsafe updates (the op is queued on
-                # the home node's next block; ClientInterface.cs:233-242)
-                self.server.reply(tag, "success", "ok")
+            items.append({
+                "tag": int(polled["client_tag"][i]),
+                "tid": int(polled["type_id"][i]),
+                "letters": _letters(int(polled["op_code"][i])),
+                "key": int(polled["key_slot"][i]),
+                "safe": bool(polled["is_safe"][i]),
+                "p0": int(polled["p0"][i]),
+                "p1": int(polled["p1"][i]),
+            })
+        reads: List[dict] = []
+        for it in items:
+            self._ingest(it, reads)
 
-        # ride pending updates on each node's next block, advance one round
-        busy = count > 0
+        # ride pending work on each node's next block, advance one round,
+        # materialize committed key creates, send deferred safe acks
+        busy = count > 0 or bool(self._waiting)
         for rt in self.types.values():
             busy |= self._step_type(rt)
+            self._materialize_creates(rt)
             self._send_safe_acks(rt)
         self.ticks += 1
 
-        # answer reads post-tick, but only once every earlier update from
-        # the same connection has boarded a block (read-your-writes —
-        # an update still pending after a B-cap overflow or a sealed-slot
-        # requeue is not yet visible in any view, yet its client already
-        # holds a 'success' reply); unready reads retry next step
-        queue = self._deferred_reads + [
-            (tid, key, home, letters, tag, ps)
-            for (tid, key, home, letters, tag), ps in zip(reads, read_params)
-        ]
+        # answer reads post-tick, once (a) the key's create has committed
+        # in the home view and (b) every earlier update from the same
+        # connection has boarded a block (read-your-writes — an update
+        # still pending after a B-cap overflow or a sealed-slot requeue
+        # is not yet visible in any view, yet its client already holds a
+        # 'success' reply); unready reads retry next step
+        queue = self._deferred_reads + reads
         self._deferred_reads = []
-        for item in queue:
-            tid, key, home, letters, tag, ps = item
-            rt = self.types[tid]
-            if self._conn_has_pending(tag >> 32):
-                self._deferred_reads.append(item)
+        for it in queue:
+            rt = self.types[it["tid"]]
+            home = (it["tag"] >> 32) % n
+            slot = rt.rks.slot(home, it["key"])
+            if slot is None or self._conn_has_pending(it["tag"] >> 32):
+                self._deferred_reads.append(it)
                 busy = True
                 continue
-            self.server.reply(tag, self._read(rt, key, home, letters, ps), "ok")
+            self.server.reply(it["tag"],
+                              self._read(rt, slot, home, it["letters"], it),
+                              "ok")
+        self._step_ms.append(1e3 * (time.perf_counter() - t_step))
+        if len(self._step_ms) > 10_000:
+            del self._step_ms[:5_000]
         return busy
+
+    def _ingest(self, it: dict, reads: List[dict]) -> None:
+        """Route one wire op: reply, queue for a block, or defer."""
+        n = self.cfg.num_nodes
+        tag, letters = it["tag"], it["letters"]
+        home = (tag >> 32) % n
+        if it["tid"] == self._stats_tid:
+            self.server.reply(tag, self._stats_report(), "ok")
+            return
+        rt = self.types.get(it["tid"])
+        if rt is None:
+            self.server.reply(tag, "error: unknown type", "err")
+            return
+        key = it["key"]
+        if letters == "s":
+            if rt.rks.slot(home, key) is not None:
+                self.server.reply(tag, "success", "ok")
+                return
+            # capacity gate counts every distinct key ever admitted
+            # (committed AND in flight) — checking only committed tables
+            # would admit overflow creates that materialization must then
+            # silently skip, hanging their clients forever
+            if key not in rt.known_keys and len(rt.known_keys) >= rt.capacity:
+                self.server.reply(tag, "error: key space full", "err")
+                return
+            # reply deferred until the create commits in the home view —
+            # slot assignment is total-order position, so creates are
+            # serializable (stricter than the reference's local-create-
+            # then-replicate, which GUID keying affords it)
+            rt.create_tags.setdefault(key, []).append((tag, home))
+            if key not in rt.known_keys:
+                rt.known_keys.add(key)
+                rt.pending[home].append((None, tag, False, key))
+            return
+        if key not in rt.known_keys:
+            self.server.reply(tag, "error: no such key", "err")
+            return
+        if letters in ("gp", "gs"):
+            reads.append(it)
+            return
+        op_id = rt.op_id(letters)
+        if op_id is None:
+            self.server.reply(tag, f"error: bad op {letters!r}", "err")
+            return
+        slot = rt.rks.slot(home, key)
+        if slot is None:
+            self._waiting.append(it)  # created, not yet committed here
+            return
+        fields = self._op_fields(rt, op_id, slot, home, it)
+        if fields is None:
+            self.server.reply(tag, "error: bad param", "err")
+            return
+        rt.pending[home].append((fields, tag, it["safe"], None))
+        if not it["safe"]:
+            # immediate reply for unsafe updates (the op is queued on
+            # the home node's next block; ClientInterface.cs:233-242)
+            self.server.reply(tag, "success", "ok")
 
     def _conn_has_pending(self, conn_id: int) -> bool:
         return any(
             (int(tag) >> 32) == conn_id
             for rt in self.types.values()
             for q in rt.pending
-            for (_, tag, _safe) in q
+            for (_f, tag, _safe, _ck) in q
+        ) or any(
+            (it["tag"] >> 32) == conn_id for it in self._waiting
         )
 
-    def _op_fields(self, rt: _TypeRuntime, op_id: int, key: int, home: int,
-                   polled, i: int) -> Optional[Dict[str, int]]:
+    def _op_fields(self, rt: _TypeRuntime, op_id: int, slot: int, home: int,
+                   it: dict) -> Optional[Dict[str, int]]:
         """Wire op -> dense op record (the CRDTCommand.Execute analog,
         PNCounterCommand.cs:12-79, ORSetCommand.cs:13-87). Returns None
         for params the device schema cannot hold — the native parser
         accepts any 18-digit int64 (server.cc:144-150), but op fields are
         int32, and an unchecked assignment would raise inside step() and
         take the whole service down with it."""
-        f = dict(op=op_id, key=key, a0=0, a1=0, a2=0, writer=home)
+        f = dict(op=op_id, key=slot, a0=0, a1=0, a2=0, writer=home)
         code = rt.spec.type_code
-        p0 = int(polled["p0"][i])
+        p0 = it["p0"]
         if code == "pnc":
             # i/d amount; default 1 when the client sent no params
             amt = int(p0) if p0 else 1
@@ -308,6 +378,22 @@ class JanusService:
                 rep, ctr = rt.minters[home].mint()
                 f["a1"], f["a2"] = rep, ctr
         return f
+
+    def _materialize_creates(self, rt: _TypeRuntime) -> None:
+        """Walk newly committed blocks; assign slots in total order and
+        send the deferred create replies whose home view materialized."""
+        for v, key, _slot in rt.rks.advance(rt.kv):
+            waiters = rt.create_tags.get(key)
+            if not waiters:
+                continue
+            still = [(tag, home) for tag, home in waiters if home != v]
+            for tag, home in waiters:
+                if home == v:
+                    self.server.reply(tag, "success", "ok")
+            if still:
+                rt.create_tags[key] = still
+            else:
+                del rt.create_tags[key]
 
     def _step_type(self, rt: _TypeRuntime) -> bool:
         """Board pending ops on each node's next block and advance one
@@ -327,17 +413,22 @@ class JanusService:
             return False
         batch = {f: np.zeros((n, B), np.int32) for f in base.OP_FIELDS}
         safe = np.zeros((n, B), bool)
-        placed: List[List[Tuple[int, bool, int]]] = [[] for _ in range(n)]
-        taken: List[List[Tuple[Dict[str, int], int, bool]]] = [[] for _ in range(n)]
+        placed: List[List[Tuple[int, bool, int, Optional[int]]]] = [
+            [] for _ in range(n)]
+        taken: List[List[tuple]] = [[] for _ in range(n)]
         for v in range(n):
             b = 0
             while rt.pending[v] and b < B:
-                fields, tag, is_safe = rt.pending[v].popleft()
-                taken[v].append((fields, tag, is_safe))
-                for name, val in fields.items():
-                    batch[name][v, b] = val
+                fields, tag, is_safe, create_key = rt.pending[v].popleft()
+                taken[v].append((fields, tag, is_safe, create_key))
+                if fields is not None:
+                    for name, val in fields.items():
+                        batch[name][v, b] = val
+                # a create rides as a no-op lane: its content is the
+                # host-side (key, block) binding; only its position in
+                # the committed order matters
                 safe[v, b] = is_safe
-                placed[v].append((b, is_safe, tag))
+                placed[v].append((b, is_safe, tag, create_key))
                 b += 1
         # record only payload-bearing blocks in latency stats; idle
         # keep-alive rounds must not grow host logs or dilute metrics
@@ -347,7 +438,10 @@ class JanusService:
         accepted, slots = info["accepted"], info["slot"]
         for v in range(n):
             if accepted[v]:
-                for b, is_safe, tag in placed[v]:
+                for b, is_safe, tag, create_key in placed[v]:
+                    if create_key is not None:
+                        rt.rks.register_create(v, create_key,
+                                               int(info["round"][v]))
                     if is_safe:
                         rt.ack_map[(int(slots[v]), v, b)] = tag
             else:
@@ -370,28 +464,39 @@ class JanusService:
                 # ClientInterface.cs:186-190)
                 self.server.reply(tag, "success", "su")
 
-    def _read(self, rt: _TypeRuntime, key: int, home: int, letters: str,
-              params: Tuple[int, ...]) -> str:
+    def _read(self, rt: _TypeRuntime, slot: int, home: int, letters: str,
+              it: dict) -> str:
         q = rt.kv.query_prospective if letters == "gp" else rt.kv.query_stable
         code = rt.spec.type_code
         if code == "pnc":
             vals = np.asarray(q("get"))  # [N, K]
-            return str(int(vals[home, key]))
+            return str(int(vals[home, slot]))
         if code == "orset":
-            elem = self._elem_id(params[0]) if params else 0
-            got = np.asarray(q("contains", key, elem))  # [N]
+            elem = self._elem_id(it["p0"])
+            got = np.asarray(q("contains", slot, elem))  # [N]
             return "true" if bool(got[home]) else "false"
         return "error: unreadable type"
 
     def _stats_report(self) -> str:
-        """PerfCounter-style report (Utlis/PerfCounter.cs:13-88,
-        StatsCommand.cs:14-21)."""
+        """In-band observability (PerfCounter.cs:13-88 + DAGStats.cs:5-66
+        + StatsCommand.cs:14-21): wire counters, ops/s windows, step
+        timing, and per-type consensus-runtime counters."""
         dt = max(time.monotonic() - self._t0, 1e-9)
         ops = self.server.ops_received()
+        steps = np.asarray(self._step_ms) if self._step_ms else np.zeros(1)
         return json.dumps({
             "ops_received": ops,
             "replies_sent": self.server.replies_sent(),
             "ticks": self.ticks,
             "uptime_sec": round(dt, 3),
             "ops_per_sec": round(ops / dt, 1),
+            "perf": self.perf.report(),
+            "step_ms_p50": round(float(np.percentile(steps, 50)), 2),
+            "step_ms_p99": round(float(np.percentile(steps, 99)), 2),
+            "types": {
+                rt.spec.type_code: {
+                    **rt.stats_snapshot(),
+                }
+                for rt in self.types.values()
+            },
         })

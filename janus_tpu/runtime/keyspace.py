@@ -7,21 +7,27 @@ SafeCRDTs for remotely-created keys (:55-113, :151-177).
 
 Tensor re-design: key *state* is pre-allocated (a type's whole key space
 is one fixed-shape tensor), so "creating" a key only means assigning it a
-slot index. Slot assignment must be identical on every node; here it is
-host-side and deterministic (interning order at the ingest boundary —
-the moral equivalent of the reference's primary-creates bootstrap).
-Create commands still flow through the DAG inside regular op batches, so
-remote views learn keys in consensus order; with a single logical ingest
-layer (the emulated-cluster setup) the host interner and the committed
-create order agree by construction. True multi-ingest deployments order
-creates by their commit position (commit_seq, round, source) — the same
-rule the reference gets from replicating its keyspace TPSet through the
-DAG.
+slot index. Two layers:
+
+- ``TypedKeySpace``/``KeySpace``: a plain host interner for single-
+  ingest setups (one logical ingest layer feeding the whole emulated
+  cluster), where interning order IS globally consistent by
+  construction. It does NOT go through consensus.
+- ``ReplicatedKeySpace``: the consensus-ordered key space. A create is
+  registered against the creating node's next DAG block; every view
+  materializes (key -> slot) by walking its committed total order, so
+  slot tables are identical across views by the same argument as stable
+  state (the reference's analog: the key space is itself a replicated
+  TPSet flowing through the DAG, KeySpaceManager.cs:55-113, with remote
+  views auto-materializing creates, :151-177). A key becomes usable at a
+  view only once its create commits there — slot assignment needs total
+  order (dense indices must agree), which is stricter than the
+  reference's GUID-keyed table and makes creates serializable.
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from janus_tpu.utils.ids import Interner
 
@@ -79,3 +85,67 @@ class KeySpace:
         sp = self.spaces[type_code]
         existed = key in sp.keys
         return sp.create(key), existed
+
+
+class ReplicatedKeySpace:
+    """Consensus-ordered key space: per-view (key -> slot) tables
+    materialized by walking each view's committed total order.
+
+    Protocol: ``register_create(node, key, round_)`` binds a create to
+    the block the creating node boards at ``round_`` (call it with the
+    round returned by the submit/step that carried the create — on
+    rejection, re-register with the next block). ``advance(kv)`` then
+    consumes each view's new ``commit_log`` entries: the first committed
+    create of an unseen key assigns it the view's next free slot.
+    Because every view walks the same total order, tables agree
+    everywhere; duplicate/concurrent creates of one key collapse to the
+    earliest committed one (KeySpaceManager's primary-creates +
+    observe-and-materialize flow, KeySpaceManager.cs:55-113, 151-177).
+    """
+
+    def __init__(self, num_views: int, capacity: int):
+        self.capacity = capacity
+        self.tables: List[Dict[object, int]] = [{} for _ in range(num_views)]
+        self.names: List[List[object]] = [[] for _ in range(num_views)]
+        # (round, source) -> [key, ...]: creates riding that block
+        self.block_creates: Dict[Tuple[int, int], List[object]] = {}
+        self._log_pos = [0] * num_views
+
+    def register_create(self, node: int, key: object, round_: int) -> None:
+        """Bind ``key``'s create to block (round_, node)."""
+        self.block_creates.setdefault((int(round_), int(node)), []).append(key)
+
+    def advance(self, kv) -> List[Tuple[int, object, int]]:
+        """Walk each view's new committed blocks; returns newly
+        materialized (view, key, slot) triples."""
+        out = []
+        for v in range(len(self.tables)):
+            log = kv.commit_log[v]
+            if len(log) < self._log_pos[v]:
+                self._log_pos[v] = 0  # view adopted a donor log; rewalk
+                self.tables[v].clear()
+                self.names[v].clear()
+            for r, s in log[self._log_pos[v]:]:
+                for key in self.block_creates.get((r, s), ()):
+                    t = self.tables[v]
+                    if key in t or len(t) >= self.capacity:
+                        continue
+                    slot = len(t)
+                    t[key] = slot
+                    self.names[v].append(key)
+                    out.append((v, key, slot))
+            self._log_pos[v] = len(log)
+        return out
+
+    def slot(self, view: int, key: object) -> Optional[int]:
+        """Key's slot in ``view``'s table, or None if not yet committed
+        there (GetKVPair analog — unknown keys are the caller's error)."""
+        return self.tables[view].get(key)
+
+    def consistent_prefix(self) -> bool:
+        """Every pair of views agrees on the common prefix of their slot
+        tables (the cross-view invariant the total order guarantees):
+        each view's list must be a prefix of the longest view's list —
+        pairwise agreement follows transitively."""
+        longest = max(self.names, key=len)
+        return all(longest[: len(nm)] == nm for nm in self.names)

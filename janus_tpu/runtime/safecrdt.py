@@ -77,13 +77,20 @@ class SafeKV:
 
     def __init__(self, cfg: dagmod.DagConfig, spec, ops_per_block: int,
                  seed: int = 0, apply_budget: int | None = None,
-                 commit_steps: int = 2, collect: bool = True, **dims):
+                 commit_steps: int = 2, collect: bool = True,
+                 collect_logs: bool = True, **dims):
         self.cfg = cfg
         self.spec = spec
         self.B = ops_per_block
         self.seed = seed
         self.commit_steps = commit_steps
         self.collect = collect
+        # collect_logs=True: the fused step's packed output also carries
+        # the full per-view commit tensors, so the host total-order log
+        # (ordered_commits) stays live on the one-fetch path. Cost is
+        # O(N^2*W) int32 per fetch — disable for large-N pure-throughput
+        # benchmarks that never read the log.
+        self.collect_logs = collect_logs
         n, w = cfg.num_nodes, cfg.num_rounds
         # blocks applied per view per tick; steady state certifies N new
         # blocks per tick, so 4N gives catch-up headroom
@@ -148,6 +155,12 @@ class SafeKV:
         self.commit_log: list[list[tuple[int, int]]] = [[] for _ in range(n)]
         self._host_slot_round = np.arange(w, dtype=np.int64)
 
+        # runtime counters (the DAGStats analog, DAGStats.cs:5-66):
+        # snapshot via dict(kv.stats)
+        self.stats: Dict[str, int] = {
+            "ticks": 0, "blocks_submitted": 0, "own_commits": 0,
+            "slots_recycled": 0, "gc_advances": 0, "state_transfers": 0,
+        }
         self._jit_submit = jax.jit(self._submit_device)
         self._jit_tick = jax.jit(self._tick_device)
         self._jit_step = jax.jit(self._step_device)
@@ -465,12 +478,21 @@ class SafeKV:
             active, withhold, invalid)
         vs = jnp.arange(n)
         own = fresh_com[vs, :, vs]  # [N, W]: own-block commits per view
-        packed = jnp.concatenate([
+        parts = [
             pre_round.astype(jnp.int32),            # [N]
             accepted.astype(jnp.int32),             # [N]
             own.reshape(-1).astype(jnp.int32),      # [N*W]
             recycled.astype(jnp.int32),             # [W]
-        ])
+        ]
+        if self.collect_logs:
+            parts += [
+                _transferred.astype(jnp.int32),     # [N]
+                _donor.astype(jnp.int32)[None],     # [1]
+                fresh_com.reshape(-1).astype(jnp.int32),   # [N*W*N]
+                _seq_snap.reshape(-1).astype(jnp.int32),   # [N*W*N]
+                dag_state["slot_round"].astype(jnp.int32),  # [W]
+            ]
+        packed = jnp.concatenate(parts)
         return (prospective, stable, dag_state, cstate, ops_buffer,
                 buffer_filled, prosp_applied, stable_applied, lost, packed)
 
@@ -484,6 +506,11 @@ class SafeKV:
         here (newly-committed detection, latency logs, safe acks,
         recycled-slot resets). ``own`` is the [W, N] own-block commit
         mask; ``rec`` the [W] recycled mask."""
+        self.stats["ticks"] += 1
+        self.stats["own_commits"] += int(own.sum())
+        if rec.any():
+            self.stats["slots_recycled"] += int(rec.sum())
+            self.stats["gc_advances"] += 1
         newly = own & (self.submit_tick >= 0) & (self.commit_tick < 0)
         self.commit_tick[newly] = tick_idx + 1
         self.latency_log.extend(
@@ -523,6 +550,7 @@ class SafeKV:
             self.prosp_applied, ops)
         acc = np.asarray(accepted)
         vs = np.arange(self.cfg.num_nodes)
+        self.stats["blocks_submitted"] += int(acc.sum())
         self.submit_tick[s[acc], vs[acc]] = self.tick_count
         self.submit_wall[s[acc], vs[acc]] = time.perf_counter()
         if safe is not None:
@@ -552,6 +580,7 @@ class SafeKV:
         # SAME donor the device code used (argmax last_wave)
         trans = np.asarray(transferred)
         if trans.any():
+            self.stats["state_transfers"] += int(trans.sum())
             d = int(donor)
             for v in np.nonzero(trans)[0]:
                 self.commit_log[int(v)] = list(self.commit_log[d])
@@ -592,9 +621,10 @@ class SafeKV:
         latency overlaps device compute — the remote-backend analog of
         the reference's async per-peer sender channels (CMNode.cs:66-98).
 
-        This path skips the per-view commit log (``ordered_commits``)
-        — fetching the full commit tensors every tick costs extra round
-        trips; use submit()/tick() where the total order log matters.
+        With ``collect_logs=True`` (the default) the packed output also
+        carries the commit tensors, so ``ordered_commits`` stays live on
+        this path at one fetch per round; constructed with
+        ``collect_logs=False`` the log is skipped for minimal fetch size.
 
         ``record`` (bool or [N] bool mask) marks which nodes' blocks
         carry real client payload this tick: unmarked blocks (idle keep-
@@ -639,19 +669,50 @@ class SafeKV:
         pre_round = flat[:n]
         acc = flat[n: 2 * n].astype(bool)
         own = flat[2 * n: 2 * n + n * w].reshape(n, w).T.astype(bool)  # [W,N]
-        rec = flat[2 * n + n * w:].astype(bool)
+        base = 2 * n + n * w
+        rec = flat[base: base + w].astype(bool)
         now = observed_at if observed_at is not None else time.perf_counter()
 
         s = pre_round % w
         vs = np.arange(n)
         st = acc & rec_mask  # only payload-bearing blocks enter the stats
+        self.stats["blocks_submitted"] += int(st.sum())
         self.submit_tick[s[st], vs[st]] = tick_idx
         self.submit_wall[s[st], vs[st]] = stamp
         if safe is not None:
             self.safe_host[s[st], vs[st]] = safe[st]
 
-        self._absorb_commits(own, rec, tick_idx, now, update_rounds=True)
-        return {"accepted": acc, "own": own, "recycled": rec, "slot": s}
+        if self.collect_logs:
+            # mirror tick()'s total-order bookkeeping from the packed
+            # extras: donor copy on transfer, then per-view ordered
+            # append using the PRE-recycle slot->round map
+            off = base + w
+            transferred = flat[off: off + n].astype(bool)
+            donor = int(flat[off + n])
+            off += n + 1
+            fresh_com = flat[off: off + n * w * n].reshape(n, w, n).astype(bool)
+            off += n * w * n
+            seqs = flat[off: off + n * w * n].reshape(n, w, n)
+            off += n * w * n
+            slot_round = flat[off: off + w].astype(np.int64)
+            if transferred.any():
+                self.stats["state_transfers"] += int(transferred.sum())
+                for v in np.nonzero(transferred)[0]:
+                    self.commit_log[int(v)] = list(self.commit_log[donor])
+            rounds = self._host_slot_round
+            for v in range(n):
+                ss, src = np.nonzero(fresh_com[v])
+                if ss.size:
+                    order = np.lexsort((src, rounds[ss], seqs[v, ss, src]))
+                    self.commit_log[v].extend(
+                        (int(rounds[ss[i]]), int(src[i])) for i in order
+                    )
+            self._absorb_commits(own, rec, tick_idx, now, update_rounds=False)
+            self._host_slot_round = slot_round
+        else:
+            self._absorb_commits(own, rec, tick_idx, now, update_rounds=True)
+        return {"accepted": acc, "own": own, "recycled": rec, "slot": s,
+                "round": pre_round.copy()}
 
     def step(self, ops: base.OpBatch, safe: Optional[np.ndarray] = None,
              active=None, withhold=None, record=True, invalid=None) -> dict:

@@ -1,0 +1,56 @@
+"""Performance counters: ops/sec sampling and runtime stat snapshots.
+
+Reference: Utlis/PerfCounter.cs:13-88 — ops counted at client-reply time,
+a 1 s-window sampler thread, report = total + per-second samples,
+surfaced in-band via the ``stats`` command (StatsCommand.cs:14-21);
+DAG-level counters in DAGConsensus/DAGStats.cs:5-66 snapshotted via
+Clone.
+
+The TPU build needs no sampler thread: ``add`` buckets counts by whole
+second at call time, so the report is reconstructable from the buckets
+alone (lazy sampling — same output shape, one less thread to races)."""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List
+
+
+class PerfCounter:
+    """Ops/sec sampler: count at reply time, report per-second windows."""
+
+    def __init__(self, max_windows: int = 600):
+        self._lock = threading.Lock()
+        self._buckets: "OrderedDict[int, int]" = OrderedDict()
+        self._total = 0
+        self._t0 = time.monotonic()
+        self.max_windows = max_windows
+
+    def add(self, n: int = 1) -> None:
+        sec = int(time.monotonic())
+        with self._lock:
+            self._total += n
+            self._buckets[sec] = self._buckets.get(sec, 0) + n
+            while len(self._buckets) > self.max_windows:
+                self._buckets.popitem(last=False)
+
+    @property
+    def total(self) -> int:
+        return self._total
+
+    def samples(self, last: int = 10) -> List[int]:
+        """Per-second op counts for the most recent ``last`` windows."""
+        now = int(time.monotonic())
+        with self._lock:
+            return [self._buckets.get(s, 0)
+                    for s in range(now - last + 1, now + 1)]
+
+    def report(self) -> Dict[str, object]:
+        dt = max(time.monotonic() - self._t0, 1e-9)
+        return {
+            "total": self._total,
+            "ops_per_sec_avg": round(self._total / dt, 1),
+            "ops_per_sec_recent": self.samples(10),
+            "uptime_sec": round(dt, 3),
+        }

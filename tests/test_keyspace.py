@@ -1,0 +1,76 @@
+"""Replicated-keyspace tests: creates ride DAG blocks, views materialize
+key->slot tables in committed total order (reference:
+KeySpaceManager.cs:55-113 primary-create + :151-177 remote
+auto-materialization, recast as commit-order slot assignment)."""
+import numpy as np
+
+from janus_tpu.consensus import DagConfig
+from janus_tpu.models import base, pncounter
+from janus_tpu.runtime.keyspace import KeySpace, ReplicatedKeySpace
+from janus_tpu.runtime.safecrdt import SafeKV
+
+N, W, B, K = 4, 8, 4, 8
+
+
+def make_kv():
+    return SafeKV(DagConfig(N, W), pncounter.SPEC, ops_per_block=B,
+                  num_keys=K, num_writers=N)
+
+
+def idle_ops():
+    return base.make_op_batch(op=np.zeros((N, B), np.int32))
+
+
+def test_create_visible_only_after_commit_and_tables_identical():
+    kv = make_kv()
+    rks = ReplicatedKeySpace(N, K)
+    # node 0 creates "alpha" riding its next block
+    info = kv.step(idle_ops())
+    rks.register_create(0, "alpha", int(info["round"][0]))
+    rks.advance(kv)
+    # not yet committed anywhere — node 3 (and even node 0) cannot see it
+    assert rks.slot(3, "alpha") is None
+    assert rks.slot(0, "alpha") is None
+    for _ in range(2 * W):
+        kv.step(idle_ops())
+        rks.advance(kv)
+    assert rks.slot(0, "alpha") == 0
+    assert rks.slot(3, "alpha") == 0
+    assert rks.consistent_prefix()
+
+
+def test_concurrent_creates_get_identical_slot_order():
+    kv = make_kv()
+    rks = ReplicatedKeySpace(N, K)
+    info = kv.step(idle_ops())
+    # all four nodes create distinct keys in the same round
+    for v in range(N):
+        rks.register_create(v, f"k{v}", int(info["round"][v]))
+    for _ in range(3 * W):
+        kv.step(idle_ops())
+        rks.advance(kv)
+    # every view assigned the same slots (total-order tie-break by source)
+    assert rks.consistent_prefix()
+    tables = rks.tables
+    assert all(t == tables[0] for t in tables)
+    assert sorted(tables[0].values()) == [0, 1, 2, 3]
+
+
+def test_duplicate_creates_collapse_to_first_committed():
+    kv = make_kv()
+    rks = ReplicatedKeySpace(N, K)
+    info = kv.step(idle_ops())
+    rks.register_create(1, "dup", int(info["round"][1]))
+    rks.register_create(2, "dup", int(info["round"][2]))
+    for _ in range(3 * W):
+        kv.step(idle_ops())
+        rks.advance(kv)
+    assert all(t.get("dup") == 0 and len(t) == 1 for t in rks.tables)
+
+
+def test_plain_keyspace_resolve():
+    ks = KeySpace({"pnc": 4})
+    s0, existed = ks.resolve("pnc", "a")
+    assert not existed and s0 == 0
+    s1, existed = ks.resolve("pnc", "a")
+    assert existed and s1 == 0

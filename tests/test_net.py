@@ -101,9 +101,16 @@ def test_stats_command(service):
     svc, port = service
     import json
     with JanusClient("127.0.0.1", port) as c:
+        c.request("pnc", "statk", "s", timeout=60)
+        c.request("pnc", "statk", "i", ["1"])
         rep = json.loads(c.request("stats", "_", "g")["result"])
         assert rep["ops_received"] > 0
         assert rep["ticks"] > 0
+        assert rep["perf"]["total"] > 0
+        assert rep["step_ms_p50"] > 0
+        t = rep["types"]["pnc"]
+        assert t["ticks"] > 0 and t["blocks_submitted"] > 0
+        assert t["own_commits"] > 0 and t["keys"] >= 1
 
 
 def test_multiple_clients_converge(service):
@@ -149,3 +156,20 @@ def test_read_your_writes_past_block_capacity(service):
         assert got == 20
         for s in seqs:
             c.wait(s, timeout=60)
+
+
+def test_keyspace_replicated_through_consensus(service):
+    """A key created via one client (home node A) becomes usable at a
+    different client (home node B) only after its create commits; slot
+    tables end identical across all views (KeySpaceManager.cs:55-113)."""
+    svc, port = service
+    with JanusClient("127.0.0.1", port) as a, JanusClient("127.0.0.1", port) as b:
+        assert a.request("pnc", "rep-key", "s", timeout=60)["result"] == "success"
+        # second client (different connection -> different home node)
+        # can use it — its view materialized the same committed create
+        assert b.request("pnc", "rep-key", "i", ["4"], timeout=60)["result"] == "success"
+        assert a.request("pnc", "rep-key", "gp", timeout=60)["result"] == "4"
+    for rt in svc.types.values():
+        assert rt.rks.consistent_prefix()
+        lens = {len(t) for t in rt.rks.tables}
+        assert len(lens) == 1  # fully drained: identical tables

@@ -157,3 +157,5 @@ def test_fused_step_matches_submit_tick():
     np.testing.assert_array_equal(kv_a.commit_latencies(),
                                   kv_b.commit_latencies())
     np.testing.assert_array_equal(kv_a.safe_acks(), kv_b.safe_acks())
+    for v in range(N):  # collect_logs keeps the total order live on step()
+        assert kv_a.ordered_commits(v) == kv_b.ordered_commits(v)
