@@ -7,6 +7,7 @@ wire type codes. Importing this package registers every built-in type.
 
 from janus_tpu.models import base  # noqa: F401
 from janus_tpu.models import pncounter  # noqa: F401
+from janus_tpu.models import rga  # noqa: F401
 from janus_tpu.models import orset  # noqa: F401
 from janus_tpu.models import lwwset  # noqa: F401
 from janus_tpu.models import tpset  # noqa: F401

@@ -1,0 +1,281 @@
+"""RGA (Replicated Growable Array) sequence CRDT over slot tensors —
+the collaborative-text type (BASELINE config 5: 1M-op log replay), the
+framework's long-sequence case.
+
+The reference has no sequence CRDT implementation — only client-side
+type stubs (MergeSharp/Examples/KVDB/Client/type/) and the paper's
+text-log discussion; this is a capability the reference names but never
+ships, built TPU-first:
+
+- An element is a slot: unique id (writer replica, Lamport counter),
+  the id of the element it was inserted AFTER (the RGA tree edge),
+  a payload character/token, and a tombstone bit. The document is the
+  depth-first traversal of that tree with siblings ordered by
+  DESCENDING id — newest-first insertion at the same anchor, the
+  classic RGA rule, which makes concurrent inserts converge.
+- Merge = the same sorted slot-union kernel as the OR-Set (ops/setops):
+  union by element id, tombstone-OR — one batched sort over
+  (replicas x docs x slots), no per-element walks.
+- Linearization (reading the document) = a PATH-KEY SORT: each element's
+  sort key is the chain of (BIG-ctr, BIG-rep) entries for its ancestors
+  root-down (computed by a bounded parent-chase), padded with -1 so a
+  parent's key is a strict lexicographic predecessor of its subtree.
+  One multi-key ``lax.sort`` then yields the exact DFS order — the
+  data-dependent tree walk becomes a static-shape sort, the moral analog
+  of blockwise attention over a long sequence (SURVEY §5 long-context).
+- Intention preservation: insert ops capture a Lamport counter at the
+  origin (max observed counter + 1, sequential within a batch via
+  base.capture_and_apply), so an element's id always exceeds everything
+  it causally observed; replay is then a pure function of op data
+  (replay-safe under any certify/commit batching).
+
+Capacity C bounds elements per document (tombstones included; compaction
+at coordination points reclaims), max_depth D bounds the ancestor chain
+the linearizer resolves — ``depth_overflow`` reports documents whose
+tree outgrew D so callers can re-shard or raise it.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+from jax import lax
+
+from janus_tpu.models import base
+from janus_tpu.ops import SENTINEL, make_slots, row_upsert, slot_union
+
+OP_INSERT = 1   # a0=char, (a1,a2)=(parent_rep, parent_ctr), writer=replica
+OP_DELETE = 2   # (a1,a2)=(target_rep, target_ctr)
+
+KEY_FIELDS = ("id_ctr", "id_rep")
+ROOT = (0, 0)   # the virtual head anchor; real ids have ctr >= 1
+State = Dict[str, jnp.ndarray]  # fields [..., K, C] + meta
+
+
+def init(num_keys: int, capacity: int, max_depth: int = 32) -> State:
+    st = make_slots(
+        capacity,
+        {"id_ctr": jnp.int32, "id_rep": jnp.int32,
+         "par_ctr": jnp.int32, "par_rep": jnp.int32,
+         "chr": jnp.int32, "dead": jnp.bool_},
+        batch=(num_keys,),
+        key_fields=KEY_FIELDS,
+    )
+    # the linearizer depth must stay STATIC under jit/vmap (it sets the
+    # sort-key count), so it rides in a zero-byte field's SHAPE — robust
+    # to the runtime broadcasting state over a leading replica axis
+    st["_depth"] = jnp.zeros((max_depth, 0), jnp.int32)
+    return st
+
+
+def _combine(p, q):
+    """Duplicate id fold: tombstone is sticky; tree edge and payload are
+    id-determined — a tombstone-only record (delete seen before its
+    insert) carries zeros, so fieldwise max recovers the real values."""
+    return {
+        "par_ctr": jnp.maximum(p["par_ctr"], q["par_ctr"]),
+        "par_rep": jnp.maximum(p["par_rep"], q["par_rep"]),
+        "chr": jnp.maximum(p["chr"], q["chr"]),
+        "dead": p["dead"] | q["dead"],
+    }
+
+
+def prepare_ops(state: State, ops: base.OpBatch) -> base.OpBatch:
+    """Effect capture: each insert records its Lamport counter — one more
+    than the largest counter observed in the target document (and at
+    least the parent's + 1, which the row max subsumes since the parent
+    is observed). Sequential intra-batch capture (capture_and_apply)
+    makes a batch of consecutive inserts mint strictly increasing
+    counters."""
+    rows_valid = state["valid"][ops["key"]]          # [B, C]
+    rows_ctr = state["id_ctr"][ops["key"]]
+    row_max = jnp.max(jnp.where(rows_valid, rows_ctr, 0), axis=-1)  # [B]
+    eff = jnp.where(ops["op"] == OP_INSERT, row_max + 1, 0)
+    return {**ops, "eff_ctr": eff[:, None].astype(jnp.int32)}
+
+
+def apply_ops(state: State, ops: base.OpBatch) -> State:
+    """Apply insert/delete ops sequentially (lax.scan over the batch —
+    inserts allocate slots and counters, so intra-batch order matters,
+    like every slot type)."""
+    has_capture = "eff_ctr" in ops
+
+    def step(st, op):
+        k = op["key"]
+        row = {f: st[f][k] for f in st if f != "_depth"}
+        en = op["op"] != base.OP_NOOP
+        is_ins = en & (op["op"] == OP_INSERT)
+        is_del = en & (op["op"] == OP_DELETE)
+
+        if has_capture:
+            ctr = op["eff_ctr"][0]
+        else:
+            # host-direct path: derive the Lamport counter here (NOT
+            # replay-safe across replicas — SafeKV always captures)
+            ctr = jnp.max(jnp.where(row["valid"], row["id_ctr"], 0)) + 1
+
+        inserted = row_upsert(
+            row, KEY_FIELDS, (ctr, op["writer"]),
+            {"par_rep": op["a1"], "par_ctr": op["a2"],
+             "chr": op["a0"], "dead": jnp.bool_(False)},
+            # redelivery/ordering fold: the tombstone is sticky, the
+            # insert's edge+payload win over a placeholder
+            combine_existing=lambda old, new: {
+                "par_rep": jnp.maximum(old["par_rep"], new["par_rep"]),
+                "par_ctr": jnp.maximum(old["par_ctr"], new["par_ctr"]),
+                "chr": jnp.maximum(old["chr"], new["chr"]),
+                "dead": old["dead"],
+            },
+            enabled=is_ins,
+        )
+        # delete: tombstone-record upsert — if the target id is not yet
+        # present (delete replayed before its insert), a dead placeholder
+        # lands and the later insert folds into it without resurrecting
+        deleted = row_upsert(
+            inserted, KEY_FIELDS, (op["a2"], op["a1"]),
+            {"par_rep": jnp.int32(0), "par_ctr": jnp.int32(0),
+             "chr": jnp.int32(0), "dead": jnp.bool_(True)},
+            combine_existing=lambda old, new: {
+                "par_rep": old["par_rep"], "par_ctr": old["par_ctr"],
+                "chr": old["chr"], "dead": jnp.bool_(True),
+            },
+            enabled=is_del,
+        )
+        st = {f: (st[f] if f == "_depth" else st[f].at[k].set(deleted[f]))
+              for f in st}
+        return st, None
+
+    state, _ = lax.scan(
+        step, state, {f: v for f, v in ops.items()})
+    return state
+
+
+def merge(a: State, b: State) -> State:
+    cap = a["id_ctr"].shape[-1]
+    sa = {f: v for f, v in a.items() if f != "_depth"}
+    sb = {f: v for f, v in b.items() if f != "_depth"}
+    out, _ = slot_union(sa, sb, KEY_FIELDS, _combine, capacity=cap)
+    out["_depth"] = a["_depth"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# linearization: path-key sort
+# ---------------------------------------------------------------------------
+
+def _order_row(row: Dict[str, jnp.ndarray], depth: int):
+    """DFS document order for one [C]-slot row.
+
+    Returns (order [C] slot indices, depth_of [C], overflow bool):
+    valid elements first in RGA order, invalid slots at the tail."""
+    C = row["id_ctr"].shape[-1]
+    valid = row["valid"]
+    # parent slot index; C = the virtual root (also for dangling refs)
+    pmat = ((row["par_ctr"][:, None] == row["id_ctr"][None, :])
+            & (row["par_rep"][:, None] == row["id_rep"][None, :])
+            & valid[None, :])
+    par_idx = jnp.where(valid & pmat.any(-1),
+                        jnp.argmax(pmat, -1), C).astype(jnp.int32)
+    par_ext = jnp.concatenate([par_idx, jnp.int32(C)[None]])
+
+    # ancestor chain self-upward, capped at `depth` links
+    def body(j, ch):
+        prev = ch[:, j - 1]
+        return ch.at[:, j].set(par_ext[prev])
+
+    chain = jnp.full((C, depth), C, jnp.int32).at[:, 0].set(jnp.arange(C))
+    chain = lax.fori_loop(1, depth, body, chain)
+    depth_of = jnp.sum(chain < C, axis=1)            # path length incl self
+    # truncated chain: deepest entry real but its parent is not the root
+    overflow = jnp.any(valid & (chain[:, depth - 1] < C)
+                       & (par_ext[chain[:, depth - 1]] < C))
+
+    # level keys root-down: level d holds ancestor chain[depth_of-1-d]
+    d_idx = depth_of[:, None] - 1 - jnp.arange(depth)[None, :]  # [C, D]
+    anc = jnp.take_along_axis(chain, jnp.clip(d_idx, 0, depth - 1), axis=1)
+    real = (d_idx >= 0) & (anc < C)
+    anc_c = jnp.clip(anc, 0, C - 1)
+    # siblings DESC by (ctr, rep) -> ascending (BIG-ctr, BIG-rep); a
+    # parent's -1 pad precedes every descendant's real entry (preorder)
+    BIG = SENTINEL
+    kc = jnp.where(real, BIG - row["id_ctr"][anc_c], -1)
+    kr = jnp.where(real, BIG - row["id_rep"][anc_c], -1)
+    kc = jnp.where(valid[:, None], kc, BIG)          # invalid to the tail
+    kr = jnp.where(valid[:, None], kr, BIG)
+
+    operands = []
+    for d in range(depth):
+        operands += [kc[:, d], kr[:, d]]
+    out = lax.sort(tuple(operands) + (jnp.arange(C, dtype=jnp.int32),),
+                   dimension=-1, num_keys=2 * depth, is_stable=True)
+    order = out[-1]
+    return order, depth_of, overflow
+
+
+def text(state: State, key) -> Dict[str, jnp.ndarray]:
+    """Materialize document ``key``: {"chr": [C] payloads in document
+    order, "live": [C] mask of visible (non-tombstoned) elements,
+    "overflow": linearizer depth overflow flag}."""
+    depth = state["_depth"].shape[-2]
+    row = {f: state[f][key] for f in state if f != "_depth"}
+    order, _, overflow = _order_row(row, depth)
+    return {
+        "chr": row["chr"][order],
+        "live": (row["valid"] & ~row["dead"])[order],
+        "overflow": overflow,
+    }
+
+
+def length(state: State, key) -> jnp.ndarray:
+    """Visible document length."""
+    return jnp.sum(state["valid"][key] & ~state["dead"][key], axis=-1)
+
+
+def element_count(state: State) -> jnp.ndarray:
+    """[..., K] occupied slots per doc (tombstones included) — the
+    capacity-pressure signal."""
+    return jnp.sum(state["valid"], axis=-1)
+
+
+def compact(state: State) -> State:
+    """Reclaim tombstoned LEAF slots (elements no live element anchors
+    on). Only safe at coordination points (after a consensus commit
+    reaches every replica) — like ORSet.compact. Interior tombstones
+    must stay: they are tree structure for their descendants."""
+    is_parent = jnp.zeros_like(state["valid"])
+    # an element is a parent if any valid element references its id
+    ref = ((state["id_ctr"][..., :, None] == state["par_ctr"][..., None, :])
+           & (state["id_rep"][..., :, None] == state["par_rep"][..., None, :])
+           & state["valid"][..., None, :])
+    is_parent = jnp.any(ref, axis=-1)
+    keep = state["valid"] & (~state["dead"] | is_parent)
+    rank = (~keep).astype(jnp.int32)
+    fields = ["id_ctr", "id_rep", "par_ctr", "par_rep", "chr", "dead"]
+    ops = ((rank,)
+           + tuple(jnp.where(keep, state[f],
+                             SENTINEL if f in KEY_FIELDS else 0)
+                   for f in fields)
+           + (keep,))
+    srt = lax.sort(ops, dimension=-1, num_keys=1, is_stable=True)
+    out = {f: v for f, v in zip(fields, srt[1:-1])}
+    out["valid"] = srt[-1]
+    out["dead"] = out["dead"] & out["valid"]
+    out["_depth"] = state["_depth"]
+    return out
+
+
+SPEC = base.register_type(
+    base.CRDTTypeSpec(
+        name="RGA",
+        type_code="rga",
+        init=init,
+        apply_ops=apply_ops,
+        merge=merge,
+        queries={"text": text, "length": length,
+                 "element_count": element_count},
+        # wire opCodes: a = insert-after, r = remove
+        op_codes={"a": OP_INSERT, "r": OP_DELETE},
+        op_extras={"eff_ctr": 1},
+        prepare_ops=prepare_ops,
+    )
+)

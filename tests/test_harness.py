@@ -54,3 +54,14 @@ def test_presets_loadable():
         assert cfg.num_nodes >= 4, name
         assert BenchConfig.from_json(
             __import__("json").dumps({"name": name})).name == name
+
+
+def test_rga_replay_small():
+    from janus_tpu.bench.harness import run_rga_replay
+    cfg = BenchConfig(name="rga-s", type_code="rga", num_nodes=8,
+                      num_objects=4, ops_per_block=8, ticks=6)
+    res = run_rga_replay(cfg)
+    d = res.to_dict()
+    assert d["throughput_ops_per_sec"] > 0
+    assert res.extra["elements_per_doc"] > 0
+    assert not res.extra["depth_overflow"]

@@ -1,0 +1,229 @@
+"""RGA sequence-CRDT tests: semantics against a host-reference model,
+convergence under merge, tombstones, depth overflow, compaction, and the
+consensus path (BASELINE config 5's type; the reference names the
+text-log case but ships no sequence CRDT — paper §6.2 / client stubs
+MergeSharp/Examples/KVDB/Client/type/)."""
+import numpy as np
+import pytest
+
+from janus_tpu.models import base, rga
+
+K, C = 2, 64
+
+
+def make(n_keys=K, cap=C, depth=16):
+    return rga.init(num_keys=n_keys, capacity=cap, max_depth=depth)
+
+
+def ins(key, ch, parent=(0, 0), writer=0):
+    """One insert op batch (host-direct: counter derived in apply)."""
+    return base.make_op_batch(
+        op=[rga.OP_INSERT], key=[key], a0=[ch],
+        a1=[parent[0]], a2=[parent[1]], writer=[writer])
+
+
+def dele(key, target):
+    return base.make_op_batch(
+        op=[rga.OP_DELETE], key=[key], a1=[target[0]], a2=[target[1]],
+        writer=[0])
+
+
+def device_text(state, key=0) -> str:
+    out = rga.text(state, key)
+    chars = np.asarray(out["chr"])
+    live = np.asarray(out["live"])
+    return "".join(chr(c) for c, m in zip(chars, live) if m)
+
+
+class HostRGA:
+    """Reference model: dict tree + DFS with descending-id siblings."""
+
+    def __init__(self):
+        self.elems = {}  # id -> dict(parent, chr, dead)
+
+    def insert(self, eid, parent, ch):
+        if eid not in self.elems:
+            self.elems[eid] = {"parent": parent, "chr": ch, "dead": False}
+
+    def delete(self, eid):
+        if eid in self.elems:
+            self.elems[eid]["dead"] = True
+        else:  # tombstone placeholder (delete before insert)
+            self.elems[eid] = {"parent": (0, 0), "chr": 0, "dead": True}
+
+    def max_ctr(self):
+        return max((ctr for ctr, _ in self.elems), default=0)
+
+    def merge(self, other):
+        for eid, e in other.elems.items():
+            if eid not in self.elems:
+                self.elems[eid] = dict(e)
+            else:
+                mine = self.elems[eid]
+                mine["dead"] = mine["dead"] or e["dead"]
+                mine["parent"] = max(mine["parent"], e["parent"])
+                mine["chr"] = max(mine["chr"], e["chr"])
+
+    def text(self) -> str:
+        kids = {}
+        for eid, e in self.elems.items():
+            kids.setdefault(e["parent"], []).append(eid)
+        for lst in kids.values():
+            lst.sort(reverse=True)  # descending (ctr, rep)
+        out = []
+
+        def dfs(eid):
+            e = self.elems.get(eid)
+            if e is not None and not e["dead"]:
+                out.append(chr(e["chr"]))
+            for kid in kids.get(eid, ()):  # descending id
+                dfs(kid)
+
+        for top in kids.get((0, 0), ()):
+            dfs(top)
+        return "".join(out)
+
+
+def test_sequential_typing_reads_in_order():
+    st = make()
+    prev = (0, 0)
+    for i, ch in enumerate("HELLO"):
+        st = rga.apply_ops(st, ins(0, ord(ch), parent=prev, writer=0))
+        prev = (0, i + 1)  # parent as (rep, ctr): ids mint ctr=1,2,...
+    assert device_text(st) == "HELLO"
+    assert int(np.asarray(rga.length(st, 0))) == 5
+
+
+def test_concurrent_inserts_same_anchor_converge_newest_first():
+    # two replicas insert at the head concurrently, then merge both ways
+    a, b = make(), make()
+    a = rga.apply_ops(a, ins(0, ord("A"), writer=1))  # id (1, 1)
+    b = rga.apply_ops(b, ins(0, ord("B"), writer=2))  # id (1, 2)
+    ab = rga.merge(a, b)
+    ba = rga.merge(b, a)
+    # same text both ways; higher id (1,2) comes first (newest-first)
+    assert device_text(ab) == device_text(ba) == "BA"
+
+
+def test_delete_tombstones_but_preserves_descendants():
+    st = make()
+    st = rga.apply_ops(st, ins(0, ord("X"), writer=0))  # id ctr=1
+    st = rga.apply_ops(st, ins(0, ord("Y"), parent=(0, 1), writer=0))
+    st = rga.apply_ops(st, dele(0, (0, 1)))  # delete X: target (rep,ctr)
+    assert device_text(st) == "Y"
+    # the tombstone still occupies a slot (structure for Y)
+    assert int(np.asarray(rga.element_count(st))[0]) == 2
+
+
+def test_delete_before_insert_does_not_resurrect():
+    st = make()
+    # delete of id (rep=3, ctr=1) replays before its insert
+    st = rga.apply_ops(st, dele(0, (3, 1)))
+    one = base.make_op_batch(op=[rga.OP_INSERT], key=[0], a0=[ord("Z")],
+                             a1=[0], a2=[0], writer=[3])
+    prepared = {**one, "eff_ctr": np.asarray([[1]], np.int32)}
+    st = rga.apply_ops(st, prepared)
+    assert device_text(st) == ""
+
+
+def test_random_traces_match_host_reference():
+    """Property test: random concurrent insert/delete traces with random
+    pairwise merges — the device text must equal the host model's."""
+    rng = np.random.default_rng(11)
+    R = 3
+    states = [make(n_keys=1, cap=128, depth=24) for _ in range(R)]
+    hosts = [HostRGA() for _ in range(R)]
+    for step in range(60):
+        r = int(rng.integers(R))
+        h, st = hosts[r], states[r]
+        observed = [eid for eid, e in h.elems.items() if e["chr"] > 0]
+        if observed and rng.random() < 0.2:
+            tgt = observed[int(rng.integers(len(observed)))]
+            states[r] = rga.apply_ops(st, dele(0, (tgt[1], tgt[0])))
+            h.delete(tgt)
+        else:
+            parent = ((0, 0) if not observed or rng.random() < 0.3
+                      else observed[int(rng.integers(len(observed)))])
+            ch = ord("a") + int(rng.integers(26))
+            ctr = h.max_ctr() + 1
+            states[r] = rga.apply_ops(
+                st, ins(0, ch, parent=(parent[1], parent[0]), writer=r))
+            h.insert((ctr, r), parent, ch)
+        if rng.random() < 0.3:
+            j = int(rng.integers(R))
+            states[r] = rga.merge(states[r], states[j])
+            states[j] = rga.merge(states[j], states[r])
+            hosts[r].merge(hosts[j])
+            hosts[j].merge(hosts[r])
+    # full convergence
+    for j in range(R):
+        states[0] = rga.merge(states[0], states[j])
+        hosts[0].merge(hosts[j])
+    got = device_text(states[0])
+    want = hosts[0].text()
+    assert got == want, f"{got!r} != {want!r}"
+
+
+def test_depth_overflow_flag():
+    st = make(depth=4)
+    prev = (0, 0)
+    for i in range(6):  # chain deeper than max_depth
+        st = rga.apply_ops(st, ins(0, ord("a") + i, parent=prev, writer=0))
+        prev = (0, i + 1)
+    out = rga.text(st, 0)
+    assert bool(np.asarray(out["overflow"]))
+    shallow = make(depth=8)
+    shallow = rga.apply_ops(shallow, ins(0, ord("x"), writer=0))
+    assert not bool(np.asarray(rga.text(shallow, 0)["overflow"]))
+
+
+def test_compact_reclaims_dead_leaves_only():
+    st = make()
+    st = rga.apply_ops(st, ins(0, ord("X"), writer=0))                # ctr 1
+    st = rga.apply_ops(st, ins(0, ord("Y"), parent=(0, 1), writer=0))  # ctr 2
+    st = rga.apply_ops(st, ins(0, ord("Z"), parent=(0, 2), writer=0))  # ctr 3
+    st = rga.apply_ops(st, dele(0, (0, 1)))  # X: interior tombstone
+    st = rga.apply_ops(st, dele(0, (0, 3)))  # Z: leaf tombstone
+    before = device_text(st)
+    st = rga.compact(st)
+    assert device_text(st) == before == "Y"
+    # Z's slot reclaimed, X kept (it anchors Y)
+    assert int(np.asarray(rga.element_count(st))[0]) == 2
+
+
+def test_rga_through_consensus():
+    """Full SafeKV path: inserts with effect-captured Lamport counters
+    ride blocks; stable == prospective and every node reads one text."""
+    import jax.numpy as jnp
+
+    from janus_tpu.consensus import DagConfig
+    from janus_tpu.runtime.safecrdt import SafeKV
+
+    N, W, B = 4, 8, 2
+    kv = SafeKV(DagConfig(N, W), rga.SPEC, ops_per_block=B,
+                num_keys=1, capacity=64, max_depth=16)
+    # each node types its own letter at the head, concurrently
+    op = np.zeros((N, B), np.int32)
+    a0 = np.zeros((N, B), np.int32)
+    writer = np.broadcast_to(np.arange(N, dtype=np.int32)[:, None], (N, B))
+    op[:, 0] = rga.OP_INSERT
+    for v in range(N):
+        a0[v, 0] = ord("A") + v
+    kv.submit(base.make_op_batch(op=op, key=np.zeros((N, B), np.int32),
+                                 a0=a0, writer=writer.copy()))
+    for _ in range(2 * W):
+        kv.tick()
+    texts = set()
+    for v in range(N):
+        out_p = rga.text({f: np.asarray(x[v]) if hasattr(x, "__getitem__")
+                          else x for f, x in kv.prospective.items()}, 0)
+        out_s = rga.text({f: np.asarray(x[v]) if hasattr(x, "__getitem__")
+                          else x for f, x in kv.stable.items()}, 0)
+        tp = "".join(chr(c) for c, m in
+                     zip(np.asarray(out_p["chr"]), np.asarray(out_p["live"])) if m)
+        ts = "".join(chr(c) for c, m in
+                     zip(np.asarray(out_s["chr"]), np.asarray(out_s["live"])) if m)
+        assert tp == ts
+        texts.add(tp)
+    assert len(texts) == 1
+    assert sorted(texts.pop()) == ["A", "B", "C", "D"]
