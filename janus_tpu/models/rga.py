@@ -151,12 +151,22 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
 
 
 def merge(a: State, b: State) -> State:
+    out, _ = merge_with_stats(a, b)
+    return out
+
+
+def merge_with_stats(a: State, b: State):
+    """Join = per-doc union of element slots; returns
+    (state, overflow[..., K]) — overflow counts elements DROPPED by
+    capacity pressure (like ORSet.merge_with_stats). Silent truncation
+    under gossip can diverge replicas, so capacity must be sized to the
+    live population and monitored through this count."""
     cap = a["id_ctr"].shape[-1]
     sa = {f: v for f, v in a.items() if f != "_depth"}
     sb = {f: v for f, v in b.items() if f != "_depth"}
-    out, _ = slot_union(sa, sb, KEY_FIELDS, _combine, capacity=cap)
+    out, overflow = slot_union(sa, sb, KEY_FIELDS, _combine, capacity=cap)
     out["_depth"] = a["_depth"]
-    return out
+    return out, overflow
 
 
 # ---------------------------------------------------------------------------
@@ -242,7 +252,6 @@ def compact(state: State) -> State:
     on). Only safe at coordination points (after a consensus commit
     reaches every replica) — like ORSet.compact. Interior tombstones
     must stay: they are tree structure for their descendants."""
-    is_parent = jnp.zeros_like(state["valid"])
     # an element is a parent if any valid element references its id
     ref = ((state["id_ctr"][..., :, None] == state["par_ctr"][..., None, :])
            & (state["id_rep"][..., :, None] == state["par_rep"][..., None, :])

@@ -1,0 +1,74 @@
+"""Interactive client CLI: ``[type] [key] [op] [isSafe?] [params...]``.
+
+Reference: BFT-CRDT-Client/CommandLineInterface.cs:18-71 + CmdParser.cs:
+20-68 — a REPL that parses ``pnc key i 5 y`` style commands into
+ClientMessages; ``y``/``n`` in the fourth position marks a safe update.
+
+Run: ``python -m janus_tpu.net.cli HOST PORT``.
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, Optional, Tuple
+
+from janus_tpu.net.client import JanusClient
+
+
+def parse_command(line: str) -> Optional[Tuple[str, str, str, bool, List[str]]]:
+    """-> (type_code, key, op_code, is_safe, params) or None on parse
+    error (CmdParser.ParseCommand analog)."""
+    parts = line.strip().split()
+    if len(parts) < 3:
+        return None
+    type_code, key, op = parts[0], parts[1], parts[2]
+    rest = parts[3:]
+    is_safe = False
+    if rest and rest[0] in ("y", "n"):
+        is_safe = rest[0] == "y"
+        rest = rest[1:]
+    return type_code, key, op, is_safe, rest
+
+
+def repl(host: str, port: int, inp=None, out=None) -> None:
+    inp = inp if inp is not None else sys.stdin
+    out = out if out is not None else sys.stdout
+    client = JanusClient(host, port)
+    print("janus-tpu client — '[type] [key] [op] [y|n] [params...]', "
+          "'quit' to exit", file=out)
+    try:
+        for line in inp:
+            line = line.strip()
+            if not line:
+                continue
+            if line in ("quit", "exit", "q"):
+                break
+            parsed = parse_command(line)
+            if parsed is None:
+                print("parse error: expected "
+                      "[type] [key] [op] [y|n] [params...]", file=out)
+                continue
+            type_code, key, op, is_safe, params = parsed
+            try:
+                rep = client.request(type_code, key, op, params, is_safe)
+            except TimeoutError as e:
+                print(f"timeout: {e}", file=out)
+                continue
+            except OSError as e:
+                # connection gone (server stopped mid-session): report
+                # like every other failure instead of a raw traceback
+                print(f"connection error: {e}", file=out)
+                break
+            print(f"{rep['result']} ({rep['response']})", file=out)
+    finally:
+        client.close()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = argv if argv is not None else sys.argv[1:]
+    host = args[0] if args else "127.0.0.1"
+    port = int(args[1]) if len(args) > 1 else 5050
+    repl(host, port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Reversible client types: optimistic CRDT updates with invariants and
+compensation.
+
+Reference: the paper's compensation mechanism and the KVDB client type
+stubs (MergeSharp/Examples/KVDB/Client/type/RCounter.py:1-40, RGraph.py,
+BFTC.py) — server-side invariant enforcement is vestigial in the
+reference (a commented "check for invarient if needed",
+SafeCRDTManager.cs:138; the banking Withdraw explicitly skips it,
+BankingWorload.cs:186-190), so reversibility lives at the client: apply
+optimistically, check the invariant against the SERIALIZABLE state once
+the safe update commits, and issue the inverse operation as compensation
+when it broke.
+
+This is the complete version of the pattern the banking app's Withdraw
+uses (stable read, then conditional safe debit): here the update runs
+first and is undone on violation, which keeps the fast path optimistic
+while the total order arbitrates conflicts."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from janus_tpu.net.client import JanusClient
+
+
+class RCounter:
+    """Reversible PN-Counter: decrements that would take the
+    serializable value below ``floor`` are compensated (re-incremented).
+
+    ``decrement`` returns (committed, compensated): (True, False) means
+    the debit stands in the total order; (True, True) means it committed
+    but broke the invariant and the inverse was issued."""
+
+    def __init__(self, client: JanusClient, key: str, floor: int = 0,
+                 timeout: Optional[float] = None):
+        self.client = client
+        self.key = key
+        self.floor = floor
+        self.timeout = timeout
+        r = client.request("pnc", key, "s", timeout=timeout)
+        if r["response"] == "err":
+            raise RuntimeError(f"create failed: {r['result']}")
+
+    def value(self, stable: bool = False) -> int:
+        op = "gs" if stable else "gp"
+        return int(self.client.request("pnc", self.key, op,
+                                       timeout=self.timeout)["result"])
+
+    def increment(self, amount: int = 1) -> None:
+        self.client.request("pnc", self.key, "i", [str(amount)],
+                            timeout=self.timeout)
+
+    def decrement(self, amount: int = 1) -> Tuple[bool, bool]:
+        """Safe (total-ordered) decrement with post-commit invariant
+        check; compensates with the inverse increment on violation."""
+        r = self.client.request("pnc", self.key, "d", [str(amount)],
+                                is_safe=True, timeout=self.timeout)
+        if r["response"] != "su":
+            return False, False
+        if self.value(stable=True) < self.floor:
+            # the total order admitted a violating interleaving:
+            # compensate with the inverse op (also total-ordered, so
+            # every replica converges on the compensated value)
+            self.client.request("pnc", self.key, "i", [str(amount)],
+                                is_safe=True, timeout=self.timeout)
+            return True, True
+        return True, False
+
+
+class RSet:
+    """Reversible OR-Set: a size-bounded add — an add that leaves the
+    SERIALIZABLE set above ``max_size`` live tags is compensated by
+    removal. The RGraph stub's shape (reversible structural updates)
+    over the set type the server exposes; the bound is arbitrated by the
+    total order, so concurrent adds from different clients resolve the
+    same way everywhere."""
+
+    def __init__(self, client: JanusClient, key: str, max_size: int,
+                 timeout: Optional[float] = None):
+        self.client = client
+        self.key = key
+        self.max_size = max_size
+        self.timeout = timeout
+        client.request("orset", key, "s", timeout=timeout)
+
+    def contains(self, elem: str, stable: bool = False) -> bool:
+        op = "gs" if stable else "gp"
+        return self.client.request("orset", self.key, op, [elem],
+                                   timeout=self.timeout)["result"] == "true"
+
+    def size(self, stable: bool = True) -> int:
+        """Live-tag count from the serializable (or prospective) state
+        — the 'ss'/'sp' wire reads."""
+        op = "ss" if stable else "sp"
+        return int(self.client.request("orset", self.key, op,
+                                       timeout=self.timeout)["result"])
+
+    def add(self, elem: str) -> Tuple[bool, bool]:
+        """Safe add; compensated (removed) if the serializable state
+        shows the bound broken once the add commits."""
+        r = self.client.request("orset", self.key, "a", [elem],
+                                is_safe=True, timeout=self.timeout)
+        if r["response"] != "su":
+            return False, False
+        if self.size(stable=True) > self.max_size:
+            self.client.request("orset", self.key, "r", [elem],
+                                is_safe=True, timeout=self.timeout)
+            return True, True
+        return True, False
+
+    def remove(self, elem: str) -> None:
+        self.client.request("orset", self.key, "r", [elem],
+                            timeout=self.timeout)

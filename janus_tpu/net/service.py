@@ -322,7 +322,7 @@ class JanusService:
         if key not in rt.known_keys:
             self.server.reply(tag, "error: no such key", "err")
             return
-        if letters in ("gp", "gs"):
+        if letters in ("gp", "gs", "sp", "ss"):
             reads.append(it)
             return
         op_id = rt.op_id(letters)
@@ -466,12 +466,19 @@ class JanusService:
 
     def _read(self, rt: _TypeRuntime, slot: int, home: int, letters: str,
               it: dict) -> str:
-        q = rt.kv.query_prospective if letters == "gp" else rt.kv.query_stable
+        """gp/gs = value reads (prospective/stable); sp/ss = size reads
+        (a wire extension beyond the reference's opCode set — needed by
+        reversible clients checking bounds against serializable state)."""
+        prosp = letters in ("gp", "sp")
+        q = rt.kv.query_prospective if prosp else rt.kv.query_stable
         code = rt.spec.type_code
         if code == "pnc":
             vals = np.asarray(q("get"))  # [N, K]
             return str(int(vals[home, slot]))
         if code == "orset":
+            if letters in ("sp", "ss"):
+                got = np.asarray(q("live_count"))  # [N, K]
+                return str(int(got[home, slot]))
             elem = self._elem_id(it["p0"])
             got = np.asarray(q("contains", slot, elem))  # [N]
             return "true" if bool(got[home]) else "false"
@@ -500,3 +507,35 @@ class JanusService:
                 for rt in self.types.values()
             },
         })
+
+
+def main(argv=None) -> None:
+    """Server entry point (the Program.cs analog, Program.cs:10-69):
+    ``python -m janus_tpu.net.service [config.json]`` starts the full
+    service and runs until SIGINT."""
+    import signal
+    import sys
+
+    args = sys.argv[1:] if argv is None else argv
+    cfg = (JanusConfig.from_json(open(args[0]).read())
+           if args else JanusConfig(port=5050))
+    stop = {"flag": False}
+    # install before the banner: a launcher may SIGINT the moment it
+    # reads the port line
+    signal.signal(signal.SIGINT, lambda *_: stop.update(flag=True))
+    svc = JanusService(cfg)
+    port = svc.start()
+    print(f"janus-tpu service on {cfg.bind_addr}:{port} "
+          f"({cfg.num_nodes} emulated nodes, window {cfg.window}); "
+          f"types: {', '.join(t.type_code for t in cfg.types)}", flush=True)
+    try:
+        import time as _t
+        while not stop["flag"]:
+            _t.sleep(0.2)
+    finally:
+        svc.stop()
+        print("stopped")
+
+
+if __name__ == "__main__":
+    main()

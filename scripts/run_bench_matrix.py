@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Ops script: run a benchmark matrix and collect results as JSON lines.
+
+Reference: BFT-CRDT-Client/scripts/multibench.py:23-115 +
+run_multi_bench.py — vary one primary variable across runs, collect
+results. Here: run named harness presets and/or the banking app, write
+one JSON line per run to results.jsonl.
+
+    python scripts/run_bench_matrix.py --presets pnc orset rga --banking
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--presets", nargs="*", default=["pnc"])
+    ap.add_argument("--banking", action="store_true")
+    ap.add_argument("--out", default="results.jsonl")
+    args = ap.parse_args()
+
+    from janus_tpu.bench.harness import PRESETS, run
+
+    with open(args.out, "a") as f:
+        for name in args.presets:
+            res = run(PRESETS[name])
+            line = json.dumps(res.to_dict())
+            print(line)
+            f.write(line + "\n")
+        if args.banking:
+            from janus_tpu.bench.banking import BankingConfig, run_banking
+            res = run_banking(BankingConfig())
+            line = json.dumps(res.to_dict())
+            print(line)
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Ops script: start/stop janus-tpu service processes.
+
+Reference: BFT-CRDT-Client/scripts/start_servers.py:27-328 — generate
+per-node configs, spawn server processes, record pids, stop/restart.
+The TPU build runs one PROCESS per cluster (nodes are emulated on
+device), so "start N" launches N independent clusters on consecutive
+ports — the shape multi-cluster experiments use.
+
+    python scripts/start_service.py start [N] [--base-port 5050]
+    python scripts/start_service.py stop
+    python scripts/start_service.py status
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+RUN_DIR = pathlib.Path(__file__).resolve().parent / ".run"
+
+
+def start(n: int, base_port: int, nodes: int, window: int) -> None:
+    RUN_DIR.mkdir(exist_ok=True)
+    pids = []
+    for i in range(n):
+        cfg = {
+            "num_nodes": nodes, "window": window, "port": base_port + i,
+            "types": [
+                {"type_code": "pnc", "dims": {"num_keys": 256}},
+                {"type_code": "orset",
+                 "dims": {"num_keys": 256, "capacity": 1024}},
+            ],
+        }
+        cfg_path = RUN_DIR / f"service.{i}.json"
+        cfg_path.write_text(json.dumps(cfg, indent=2))
+        log = open(RUN_DIR / f"service.{i}.log", "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "janus_tpu.net.service", str(cfg_path)],
+            stdout=log, stderr=subprocess.STDOUT,
+            cwd=pathlib.Path(__file__).resolve().parent.parent,
+        )
+        pids.append(proc.pid)
+        print(f"cluster {i}: pid {proc.pid} port {base_port + i}")
+    (RUN_DIR / "pids").write_text("\n".join(map(str, pids)))
+
+
+def stop() -> None:
+    pid_file = RUN_DIR / "pids"
+    if not pid_file.exists():
+        print("nothing running")
+        return
+    for pid in map(int, pid_file.read_text().split()):
+        try:
+            os.kill(pid, signal.SIGINT)
+            print(f"stopped {pid}")
+        except ProcessLookupError:
+            print(f"{pid} already gone")
+    pid_file.unlink()
+
+
+def status() -> None:
+    pid_file = RUN_DIR / "pids"
+    if not pid_file.exists():
+        print("nothing running")
+        return
+    for pid in map(int, pid_file.read_text().split()):
+        try:
+            os.kill(pid, 0)
+            print(f"{pid} alive")
+        except ProcessLookupError:
+            print(f"{pid} dead")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("cmd", choices=("start", "stop", "status"))
+    ap.add_argument("n", nargs="?", type=int, default=1)
+    ap.add_argument("--base-port", type=int, default=5050)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--window", type=int, default=8)
+    args = ap.parse_args()
+    if args.cmd == "start":
+        start(args.n, args.base_port, args.nodes, args.window)
+    elif args.cmd == "stop":
+        stop()
+    else:
+        status()
+
+
+if __name__ == "__main__":
+    main()

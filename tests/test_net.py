@@ -173,3 +173,96 @@ def test_keyspace_replicated_through_consensus(service):
         assert rt.rks.consistent_prefix()
         lens = {len(t) for t in rt.rks.tables}
         assert len(lens) == 1  # fully drained: identical tables
+
+
+def test_cli_parse_and_repl(service):
+    """CmdParser + REPL analog: commands typed as '[type] [key] [op]
+    [y|n] [params]' drive the live service (CommandLineInterface.cs)."""
+    import io
+
+    from janus_tpu.net.cli import parse_command, repl
+
+    assert parse_command("pnc k i y 5") == ("pnc", "k", "i", True, ["5"])
+    assert parse_command("orset s gp 1") == ("orset", "s", "gp", False, ["1"])
+    assert parse_command("bad") is None
+
+    svc, port = service
+    out = io.StringIO()
+    script = io.StringIO(
+        "pnc clik s\npnc clik i n 7\npnc clik gp\nquit\n")
+    repl("127.0.0.1", port, inp=script, out=out)
+    lines = out.getvalue().splitlines()
+    assert any(l.startswith("7 ") for l in lines), lines
+
+
+def test_service_process_entry_point(tmp_path):
+    """Program.cs analog: the service runs as its own process from a
+    JSON config, serves a client, and stops on SIGINT."""
+    import json as _json
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    cfg = {"num_nodes": 4, "window": 8, "ops_per_block": 8, "port": 0,
+           "types": [{"type_code": "pnc", "dims": {"num_keys": 8}}]}
+    p = tmp_path / "svc.json"
+    p.write_text(_json.dumps(cfg))
+    # port 0 is ephemeral; have the child print it, then connect
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "janus_tpu.net.service", str(p)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.monotonic() + 120
+        line = ""
+        seen = []
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if "janus-tpu service on" in line:
+                break
+            seen.append(line)
+            if line == "" and proc.poll() is not None:
+                raise AssertionError(f"service died: {''.join(seen)}")
+        assert "janus-tpu service on" in line, line
+        port = int(line.split("on ")[1].split()[0].split(":")[1])
+        with JanusClient("127.0.0.1", port, timeout=120) as c:
+            assert c.request("pnc", "x", "s", timeout=120)["result"] == "success"
+            assert c.request("pnc", "x", "i", ["2"])["result"] == "success"
+    finally:
+        proc.send_signal(signal.SIGINT)
+        assert proc.wait(timeout=30) == 0
+
+
+def test_reversible_counter_compensation(service):
+    """RCounter (Examples/KVDB/Client/type/RCounter.py analog): a safe
+    decrement that drives the serializable value below the floor is
+    compensated by its inverse; a covered decrement stands."""
+    from janus_tpu.net.reversible import RCounter
+
+    svc, port = service
+    with JanusClient("127.0.0.1", port, timeout=120) as c:
+        rc = RCounter(c, "rbal", floor=0, timeout=120)
+        rc.increment(10)
+        committed, compensated = rc.decrement(4)
+        assert committed and not compensated
+        assert rc.value(stable=True) == 6
+        committed, compensated = rc.decrement(50)  # overdraft
+        assert committed and compensated
+        assert rc.value(stable=True) == 6  # restored by compensation
+
+
+def test_reversible_set_bound_compensation(service):
+    """RSet: the size bound is arbitrated by the serializable state, so
+    it holds across clients sharing the key (unlike any local count)."""
+    from janus_tpu.net.reversible import RSet
+
+    svc, port = service
+    with JanusClient("127.0.0.1", port, timeout=120) as a, \
+            JanusClient("127.0.0.1", port, timeout=120) as b:
+        sa = RSet(a, "bounded", max_size=2, timeout=120)
+        sb = RSet(b, "bounded", max_size=2, timeout=120)
+        assert sa.add("x") == (True, False)
+        assert sb.add("y") == (True, False)
+        committed, compensated = sa.add("z")  # third: over the bound
+        assert committed and compensated
+        assert sa.size(stable=True) <= 2
