@@ -225,6 +225,77 @@ def recycle(cfg: DagConfig, state: State, new_base) -> State:
     return out
 
 
+def ingest_batch(cfg: DagConfig, state: State, seen_by,
+                 blocks=(), sigs=(), certs=()) -> State:
+    """Apply DAG messages received over an external wire (the message
+    plane): ``blocks`` = [(round, source, edges_row)], ``sigs`` =
+    [(round, source, signer)], ``certs`` = [(round, source)];
+    ``seen_by`` lists the local node ids that observe them. The
+    host-boundary analog of ReceivedBlock/ReceivedSignature/
+    ReceivedCertificate (DAG.cs:413-472, 495-568, 574-609).
+
+    Safety at the wire boundary: a message only lands if its slot still
+    OWNS its logical round (``slot_round[r % W] == r``) — a stale
+    (pre-GC) or out-of-window message must not write into a slot that
+    belongs to a different round (every local path guards this via
+    create_blocks' in_window / advance_rounds' bound; phantom certs from
+    recycled rounds would otherwise count toward a later round's
+    quorum). All writes are MONOTONE (max/or), preserving the module
+    invariant that block content is fixed at creation — a duplicate or
+    malformed re-send can never clear recorded state. One batched
+    scatter per field; eager per-message .at updates would copy the full
+    state tensors per frame."""
+    import numpy as _np
+
+    out = dict(state)
+    sb = jnp.asarray(seen_by)
+    if len(blocks):
+        rs = _np.asarray([b[0] for b in blocks], _np.int32)
+        srcs = _np.asarray([b[1] for b in blocks], _np.int32)
+        rows = _np.stack([_np.asarray(b[2], bool) for b in blocks])
+        ss = slot_of(cfg, rs)
+        ok = state["slot_round"][ss] == jnp.asarray(rs)
+        out["block_exists"] = out["block_exists"].at[ss, srcs].max(ok)
+        out["edges"] = out["edges"].at[ss, srcs, :].max(
+            jnp.asarray(rows) & ok[:, None])
+        out["block_seen"] = out["block_seen"].at[
+            sb[:, None], ss[None, :], srcs[None, :]].max(ok[None, :])
+    if len(sigs):
+        rs = _np.asarray([g[0] for g in sigs], _np.int32)
+        srcs = _np.asarray([g[1] for g in sigs], _np.int32)
+        signers = _np.asarray([g[2] for g in sigs], _np.int32)
+        ss = slot_of(cfg, rs)
+        ok = state["slot_round"][ss] == jnp.asarray(rs)
+        out["acks"] = out["acks"].at[ss, srcs, signers].max(ok)
+    if len(certs):
+        rs = _np.asarray([c[0] for c in certs], _np.int32)
+        srcs = _np.asarray([c[1] for c in certs], _np.int32)
+        ss = slot_of(cfg, rs)
+        ok = state["slot_round"][ss] == jnp.asarray(rs)
+        out["cert_exists"] = out["cert_exists"].at[ss, srcs].max(ok)
+        out["cert_seen"] = out["cert_seen"].at[
+            sb[:, None], ss[None, :], srcs[None, :]].max(ok[None, :])
+    return out
+
+
+def ingest_block(cfg: DagConfig, state: State, r: int, source: int,
+                 edges_row, seen_by) -> State:
+    """Single-message convenience over ingest_batch."""
+    return ingest_batch(cfg, state, seen_by, blocks=[(r, source, edges_row)])
+
+
+def ingest_signature(cfg: DagConfig, state: State, r: int, source: int,
+                     signer: int) -> State:
+    """Single-message convenience over ingest_batch."""
+    return ingest_batch(cfg, state, [], sigs=[(r, source, signer)])
+
+
+def ingest_certificate(cfg: DagConfig, state: State, r: int, source: int,
+                       seen_by) -> State:
+    """Single-message convenience over ingest_batch."""
+    return ingest_batch(cfg, state, seen_by, certs=[(r, source)])
+
+
 def round_step(cfg: DagConfig, state: State, active: Optional[jnp.ndarray] = None,
                withhold: Optional[jnp.ndarray] = None,
                invalid: Optional[jnp.ndarray] = None) -> State:

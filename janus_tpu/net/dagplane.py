@@ -1,0 +1,220 @@
+"""Serialized DAG message plane + split-cluster transport: the
+replica-to-replica wire (Cluster/CMNode/ManagerServer analog) for
+deployments where the emulated cluster spans more than one process/host.
+
+Reference: DAG messages are a protobuf class hierarchy with SUBTYPE
+FRAMING — the length-prefix frame's field number names the message type,
+and the receive loop demuxes on it (DAGConsensus/DAGMessage.cs:13-64
+MessageTypeResolver; send side CMNode.cs:81 SerializeWithLengthPrefix
+with fieldNumber=msg.type; recv side ManagerServer.cs:86-138). The same
+scheme is used here over the Base128 framing the client plane already
+speaks (net/client.frame): field 2=block, 3=certificate, 4=signature.
+
+Deployment model: inside one process/mesh, replica communication is
+tensor delivery masks and collectives — no wire at all (SURVEY §2.5).
+Across processes, each endpoint OWNS a subset of the emulated nodes: its
+owned nodes create/sign/certify locally (masked phases), and the
+endpoint serializes its new blocks/signatures/certificates to peers,
+ingesting theirs via dag.ingest_* — the reference's exact message
+economy (broadcast blocks, unicast sigs to the creator, broadcast
+certs), so the global DAG converges across hosts while the hot loops
+stay on-device. TCP transport below is thread-per-peer with
+length-prefixed frames (CMNode's channel+sender-thread shape)."""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from janus_tpu.consensus import dag as dagmod
+from janus_tpu.consensus.dag import DagConfig
+from janus_tpu.net.client import _read_varint, _varint, frame
+
+MSG_BLOCK = 2
+MSG_CERT = 3
+MSG_SIG = 4
+
+
+def encode_block(r: int, source: int, edges_row: np.ndarray) -> bytes:
+    body = bytearray()
+    body += _varint(int(r))
+    body += _varint(int(source))
+    bits = np.asarray(edges_row, bool)
+    body += _varint(len(bits))
+    body += bytes(np.packbits(bits).tobytes())
+    return frame(bytes(body), MSG_BLOCK)
+
+
+def encode_certificate(r: int, source: int) -> bytes:
+    return frame(_varint(int(r)) + _varint(int(source)), MSG_CERT)
+
+
+def encode_signature(r: int, source: int, signer: int) -> bytes:
+    return frame(_varint(int(r)) + _varint(int(source))
+                 + _varint(int(signer)), MSG_SIG)
+
+
+def decode_messages(buf: bytearray) -> List[Tuple[int, dict]]:
+    """Drain complete frames from ``buf``; returns (msg_type, fields)
+    pairs (the MessageTypeResolver demux)."""
+    out = []
+    while True:
+        tag, off = _read_varint(buf, 0)
+        if tag is None:
+            break
+        n, off = _read_varint(buf, off)
+        if n is None or off + n > len(buf):
+            break
+        payload = bytes(buf[off: off + n])
+        del buf[: off + n]
+        mtype = tag >> 3
+        r, p = _read_varint(payload, 0)
+        src, p = _read_varint(payload, p)
+        fields = {"round": r, "source": src}
+        if mtype == MSG_BLOCK:
+            nbits, p = _read_varint(payload, p)
+            bits = np.unpackbits(
+                np.frombuffer(payload[p:], np.uint8), count=nbits
+            ).astype(bool)
+            fields["edges"] = bits
+        elif mtype == MSG_SIG:
+            fields["signer"], p = _read_varint(payload, p)
+        out.append((mtype, fields))
+    return out
+
+
+class SplitClusterEndpoint:
+    """One process's share of an emulated cluster: owned nodes act via
+    masked tensor phases; everything else arrives as DAG messages.
+
+    ``send(bytes)`` is pluggable (TCP, in-memory queue, ...); feed
+    received bytes to ``receive``. Call ``step()`` once per protocol
+    round."""
+
+    def __init__(self, cfg: DagConfig, owned: np.ndarray, send=None):
+        self.cfg = cfg
+        self.owned = np.asarray(owned, bool)
+        self.owned_idx = np.nonzero(self.owned)[0]
+        self.state = dagmod.init(cfg)
+        self.send = send or (lambda data: None)
+        self._rxbuf = bytearray()
+        self._rxlock = threading.Lock()
+        # delivery mask: only owned nodes receive locally
+        n, w = cfg.num_nodes, cfg.num_rounds
+        self._recv_mask = np.zeros((n, w, n), bool)
+        self._recv_mask[self.owned] = True
+        import jax.numpy as jnp
+        self._recv_mask = jnp.asarray(self._recv_mask)
+        self._act = jnp.asarray(self.owned)
+
+    # -- wire ------------------------------------------------------------
+
+    def receive(self, data: bytes) -> None:
+        with self._rxlock:
+            self._rxbuf.extend(data)
+
+    def _drain_inbox(self) -> None:
+        with self._rxlock:
+            msgs = decode_messages(self._rxbuf)
+        if not msgs:
+            return
+        blocks, sigs, certs = [], [], []
+        for mtype, f in msgs:
+            if mtype == MSG_BLOCK:
+                blocks.append((f["round"], f["source"], f["edges"]))
+            elif mtype == MSG_SIG:
+                sigs.append((f["round"], f["source"], f["signer"]))
+            elif mtype == MSG_CERT:
+                certs.append((f["round"], f["source"]))
+        self.state = dagmod.ingest_batch(
+            self.cfg, self.state, self.owned_idx,
+            blocks=blocks, sigs=sigs, certs=certs)
+
+    # -- protocol --------------------------------------------------------
+
+    def step(self) -> None:
+        """One masked protocol round + message exchange:
+        create (owned) -> broadcast new blocks -> sign (owned signers;
+        unicast sigs for remote creators) -> certify (owned creators;
+        broadcast new certs) -> deliver -> advance."""
+        cfg = self.cfg
+        st = self.state
+        self._drain_inbox()
+        st = self.state  # may have been replaced by ingest
+
+        before_blocks = np.asarray(st["block_exists"])
+        st = dagmod.create_blocks(cfg, st, self._act)
+        new_blocks = np.asarray(st["block_exists"]) & ~before_blocks
+        sr = np.asarray(st["slot_round"])
+        for s, src in zip(*np.nonzero(new_blocks)):
+            self.send(encode_block(int(sr[s]), int(src),
+                                   np.asarray(st["edges"])[s, src]))
+
+        st = dagmod.deliver_blocks(cfg, st, self._recv_mask)
+
+        before_acks = np.asarray(st["acks"])
+        st = dagmod.sign_blocks(cfg, st, self._recv_mask)
+        new_acks = np.asarray(st["acks"]) & ~before_acks
+        for s, src, signer in zip(*np.nonzero(new_acks)):
+            if not self.owned[src]:  # unicast to the remote creator
+                self.send(encode_signature(int(sr[s]), int(src), int(signer)))
+
+        # only owned creators may assemble certificates
+        withhold = np.broadcast_to(~self.owned[None, :],
+                                   (cfg.num_rounds, cfg.num_nodes))
+        import jax.numpy as jnp
+        before_certs = np.asarray(st["cert_exists"])
+        st = dagmod.form_certificates(cfg, st, jnp.asarray(withhold))
+        new_certs = np.asarray(st["cert_exists"]) & ~before_certs
+        for s, src in zip(*np.nonzero(new_certs)):
+            self.send(encode_certificate(int(sr[s]), int(src)))
+
+        st = dagmod.deliver_certificates(cfg, st, self._recv_mask)
+        st = dagmod.advance_rounds(cfg, st)
+        self.state = st
+
+    def node_rounds(self) -> np.ndarray:
+        return np.asarray(self.state["node_round"])[self.owned]
+
+
+class TcpPeer:
+    """Bidirectional framed byte pipe to one peer (CMNode + ManagerServer
+    in one: dedicated sender path, receive thread feeding a callback)."""
+
+    def __init__(self, sock: socket.socket, on_receive):
+        self.sock = sock
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self._on_receive = on_receive
+        self._closed = False
+        self._rx = threading.Thread(target=self._recv_loop, daemon=True)
+        self._rx.start()
+
+    @classmethod
+    def connect(cls, host: str, port: int, on_receive) -> "TcpPeer":
+        return cls(socket.create_connection((host, port), timeout=30),
+                   on_receive)
+
+    def send(self, data: bytes) -> None:
+        with self._lock:
+            self.sock.sendall(data)
+
+    def _recv_loop(self):
+        while not self._closed:
+            try:
+                chunk = self.sock.recv(65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            self._on_receive(chunk)
+
+    def close(self):
+        self._closed = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
