@@ -250,14 +250,27 @@ def ingest_batch(cfg: DagConfig, state: State, seen_by,
     out = dict(state)
     sb = jnp.asarray(seen_by)
     if len(blocks):
-        rs = _np.asarray([b[0] for b in blocks], _np.int32)
-        srcs = _np.asarray([b[1] for b in blocks], _np.int32)
-        rows = _np.stack([_np.asarray(b[2], bool) for b in blocks])
+        # dedupe within the batch (first copy wins, deterministically)
+        seen_ids = set()
+        uniq = []
+        for b in blocks:
+            if (int(b[0]), int(b[1])) not in seen_ids:
+                seen_ids.add((int(b[0]), int(b[1])))
+                uniq.append(b)
+        rs = _np.asarray([b[0] for b in uniq], _np.int32)
+        srcs = _np.asarray([b[1] for b in uniq], _np.int32)
+        rows = _np.stack([_np.asarray(b[2], bool) for b in uniq])
         ss = slot_of(cfg, rs)
         ok = state["slot_round"][ss] == jnp.asarray(rs)
+        # edges are FIRST-WRITE-WINS like the local path (create_blocks
+        # only writes where the block didn't exist): a re-send or an
+        # equivocating copy with different edges must not mutate the
+        # recorded content — cross-endpoint equivocation detection
+        # belongs to the integrity plane's digests
+        fresh = ok & ~state["block_exists"][ss, srcs]
         out["block_exists"] = out["block_exists"].at[ss, srcs].max(ok)
         out["edges"] = out["edges"].at[ss, srcs, :].max(
-            jnp.asarray(rows) & ok[:, None])
+            jnp.asarray(rows) & fresh[:, None])
         out["block_seen"] = out["block_seen"].at[
             sb[:, None], ss[None, :], srcs[None, :]].max(ok[None, :])
     if len(sigs):

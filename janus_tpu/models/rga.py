@@ -225,13 +225,16 @@ def _order_row(row: Dict[str, jnp.ndarray], depth: int):
 def text(state: State, key) -> Dict[str, jnp.ndarray]:
     """Materialize document ``key``: {"chr": [C] payloads in document
     order, "live": [C] mask of visible (non-tombstoned) elements,
-    "overflow": linearizer depth overflow flag}."""
+    "id_rep"/"id_ctr": [C] element ids in the same order (anchors for
+    position-based editing APIs), "overflow": linearizer depth flag}."""
     depth = state["_depth"].shape[-2]
     row = {f: state[f][key] for f in state if f != "_depth"}
     order, _, overflow = _order_row(row, depth)
     return {
         "chr": row["chr"][order],
         "live": (row["valid"] & ~row["dead"])[order],
+        "id_rep": row["id_rep"][order],
+        "id_ctr": row["id_ctr"][order],
         "overflow": overflow,
     }
 

@@ -70,18 +70,29 @@ def decode_messages(buf: bytearray) -> List[Tuple[int, dict]]:
         payload = bytes(buf[off: off + n])
         del buf[: off + n]
         mtype = tag >> 3
-        r, p = _read_varint(payload, 0)
-        src, p = _read_varint(payload, p)
-        fields = {"round": r, "source": src}
-        if mtype == MSG_BLOCK:
-            nbits, p = _read_varint(payload, p)
-            bits = np.unpackbits(
-                np.frombuffer(payload[p:], np.uint8), count=nbits
-            ).astype(bool)
-            fields["edges"] = bits
-        elif mtype == MSG_SIG:
-            fields["signer"], p = _read_varint(payload, p)
-        out.append((mtype, fields))
+        # a malformed frame from one buggy/Byzantine peer must be
+        # droppable, never fatal to the honest endpoint's step loop
+        try:
+            r, p = _read_varint(payload, 0)
+            src, p = _read_varint(payload, p)
+            if r is None or src is None:
+                continue
+            fields = {"round": r, "source": src}
+            if mtype == MSG_BLOCK:
+                nbits, p = _read_varint(payload, p)
+                if nbits is None or nbits > 8 * (len(payload) - p):
+                    continue
+                bits = np.unpackbits(
+                    np.frombuffer(payload[p:], np.uint8), count=nbits
+                ).astype(bool)
+                fields["edges"] = bits
+            elif mtype == MSG_SIG:
+                fields["signer"], p = _read_varint(payload, p)
+                if fields["signer"] is None:
+                    continue
+            out.append((mtype, fields))
+        except (ValueError, TypeError):
+            continue
     return out
 
 
@@ -140,9 +151,8 @@ class SplitClusterEndpoint:
         unicast sigs for remote creators) -> certify (owned creators;
         broadcast new certs) -> deliver -> advance."""
         cfg = self.cfg
-        st = self.state
         self._drain_inbox()
-        st = self.state  # may have been replaced by ingest
+        st = self.state
 
         before_blocks = np.asarray(st["block_exists"])
         st = dagmod.create_blocks(cfg, st, self._act)
@@ -186,6 +196,10 @@ class TcpPeer:
     def __init__(self, sock: socket.socket, on_receive):
         self.sock = sock
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # a connect timeout must not survive as a recv timeout: an idle
+        # peer (>30s between rounds) would otherwise silently kill the
+        # receive thread and drop every later message
+        self.sock.settimeout(None)
         self._lock = threading.Lock()
         self._on_receive = on_receive
         self._closed = False

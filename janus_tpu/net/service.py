@@ -98,6 +98,10 @@ class _TypeRuntime:
         dims = dict(tcfg.dims)
         if tcfg.type_code == "pnc":
             dims.setdefault("num_writers", cfg.num_nodes)
+        if tcfg.type_code == "rga":
+            # worst-case append chains are capacity deep; default the
+            # linearizer bound to match so common typing never overflows
+            dims.setdefault("max_depth", int(dims["capacity"]))
         self.spec = spec
         self.kv = SafeKV(DagConfig(cfg.num_nodes, cfg.window), spec,
                          ops_per_block=cfg.ops_per_block, **dims)
@@ -333,6 +337,13 @@ class JanusService:
         if slot is None:
             self._waiting.append(it)  # created, not yet committed here
             return
+        if rt.spec.type_code == "rga" and self._conn_has_pending(tag >> 32):
+            # position-based ops resolve their anchor against the home
+            # view's CURRENT order — earlier pipelined edits from this
+            # connection must board (and fast-path apply) first or the
+            # index would resolve against a stale document
+            self._waiting.append(it)
+            return
         fields = self._op_fields(rt, op_id, slot, home, it)
         if fields is None:
             self.server.reply(tag, "error: bad param", "err")
@@ -377,7 +388,59 @@ class JanusService:
             if op_id == orset_mod.OP_ADD:
                 rep, ctr = rt.minters[home].mint()
                 f["a1"], f["a2"] = rep, ctr
+        elif code == "rga":
+            # position-based text API: clients never see CRDT ids —
+            # 'a' = [char_code, index], 'r' = [index]; the service
+            # resolves the index against the home view's current order
+            # (the id-anchored op is what replicates, so concurrent
+            # edits still converge RGA-style)
+            import janus_tpu.models.rga as rga_mod
+            if op_id == rga_mod.OP_INSERT:
+                if not (0 < p0 < 0x110000):
+                    return None
+                f["a0"] = int(p0)
+                anchor = self._rga_anchor(rt, slot, home, int(it["p1"]))
+                if anchor is None:
+                    return None
+                f["a1"], f["a2"] = anchor
+            else:  # delete at index
+                target = self._rga_target(rt, slot, home, int(p0))
+                if target is None:
+                    return None
+                f["a1"], f["a2"] = target
         return f
+
+    def _rga_doc(self, rt: _TypeRuntime, slot: int, home: int):
+        out = rt.kv.query_prospective("text", slot)
+        if bool(np.asarray(out["overflow"])[home]):
+            return None  # order unreliable past max_depth: refuse edits
+        live = np.asarray(out["live"])[home]
+        return {
+            "rep": np.asarray(out["id_rep"])[home][live],
+            "ctr": np.asarray(out["id_ctr"])[home][live],
+        }
+
+    def _rga_anchor(self, rt: _TypeRuntime, slot: int, home: int,
+                    pos: int) -> Optional[Tuple[int, int]]:
+        """Insert-before-``pos`` -> the id of the live element at pos-1
+        (root for pos<=0; clamped to append past the end)."""
+        if pos <= 0:
+            return (0, 0)
+        doc = self._rga_doc(rt, slot, home)
+        if doc is None:
+            return None
+        n = len(doc["rep"])
+        if n == 0:
+            return (0, 0)
+        i = min(pos, n) - 1
+        return (int(doc["rep"][i]), int(doc["ctr"][i]))
+
+    def _rga_target(self, rt: _TypeRuntime, slot: int, home: int,
+                    pos: int) -> Optional[Tuple[int, int]]:
+        doc = self._rga_doc(rt, slot, home)
+        if doc is None or not (0 <= pos < len(doc["rep"])):
+            return None
+        return (int(doc["rep"][pos]), int(doc["ctr"][pos]))
 
     def _materialize_creates(self, rt: _TypeRuntime) -> None:
         """Walk newly committed blocks; assign slots in total order and
@@ -482,6 +545,18 @@ class JanusService:
             elem = self._elem_id(it["p0"])
             got = np.asarray(q("contains", slot, elem))  # [N]
             return "true" if bool(got[home]) else "false"
+        if code == "rga":
+            if letters in ("sp", "ss"):
+                got = np.asarray(q("length", slot))  # [N]
+                return str(int(got[home]))
+            out = q("text", slot)
+            if bool(np.asarray(out["overflow"])[home]):
+                # misordered text must never be served silently; raise
+                # the type's max_depth (defaults to capacity)
+                return "error: depth overflow"
+            live = np.asarray(out["live"])[home]
+            chars = np.asarray(out["chr"])[home][live]
+            return "".join(chr(int(c)) for c in chars)
         return "error: unreadable type"
 
     def _stats_report(self) -> str:

@@ -759,3 +759,71 @@ class SafeKV:
         """The node's full committed total order, (round, source) pairs,
         from the host-side append-only log (GC-proof)."""
         return list(self.commit_log[node])
+
+    # -- checkpoint / resume ----------------------------------------------
+
+    def checkpoint(self, path: str) -> None:
+        """Persist the full cluster state (device tensors + host
+        bookkeeping) to one .npz file. The reference has NO persistence
+        — its GC comment even says "(assume they are already persisted)"
+        (DAG.cs:946-965); checkpointing the state pytree is the easy
+        capability the tensor design adds (SURVEY §5 checkpoint/resume).
+        Checkpoint at a quiet point (between step/tick calls)."""
+        flat = {}
+
+        def put(prefix, tree):
+            for f, v in tree.items():
+                flat[f"{prefix}.{f}"] = np.asarray(v)
+
+        put("prospective", self.prospective)
+        put("stable", self.stable)
+        put("dag", self.dag)
+        put("commit", self.commit)
+        put("ops_buffer", self.ops_buffer)
+        flat["buffer_filled"] = np.asarray(self.buffer_filled)
+        flat["prosp_applied"] = np.asarray(self.prosp_applied)
+        flat["stable_applied"] = np.asarray(self.stable_applied)
+        flat["force_transfer"] = np.asarray(self.force_transfer)
+        flat["submit_tick"] = self.submit_tick
+        flat["commit_tick"] = self.commit_tick
+        flat["submit_wall"] = self.submit_wall
+        flat["safe_host"] = self.safe_host
+        flat["pending_safe_acks"] = self.pending_safe_acks
+        flat["host_slot_round"] = self._host_slot_round
+        flat["scalars"] = np.asarray([self.tick_count, self._absorb_tick])
+        flat["latency_log"] = np.asarray(self.latency_log, np.int64)
+        flat["wall_latency_log"] = np.asarray(self.wall_latency_log)
+        for v, log in enumerate(self.commit_log):
+            flat[f"commit_log.{v}"] = np.asarray(log, np.int64).reshape(-1, 2)
+        np.savez_compressed(path, **flat)
+
+    def restore(self, path: str) -> None:
+        """Load a checkpoint written by ``checkpoint`` into this
+        instance (construct it with the same config/spec/dims first)."""
+        with np.load(path) as data:
+            def get(prefix, tree):
+                return {f: jnp.asarray(data[f"{prefix}.{f}"]) for f in tree}
+
+            self.prospective = get("prospective", self.prospective)
+            self.stable = get("stable", self.stable)
+            self.dag = get("dag", self.dag)
+            self.commit = get("commit", self.commit)
+            self.ops_buffer = get("ops_buffer", self.ops_buffer)
+            self.buffer_filled = jnp.asarray(data["buffer_filled"])
+            self.prosp_applied = jnp.asarray(data["prosp_applied"])
+            self.stable_applied = jnp.asarray(data["stable_applied"])
+            self.force_transfer = jnp.asarray(data["force_transfer"])
+            self.submit_tick = data["submit_tick"].copy()
+            self.commit_tick = data["commit_tick"].copy()
+            self.submit_wall = data["submit_wall"].copy()
+            self.safe_host = data["safe_host"].copy()
+            self.pending_safe_acks = data["pending_safe_acks"].copy()
+            self._host_slot_round = data["host_slot_round"].copy()
+            self.tick_count = int(data["scalars"][0])
+            self._absorb_tick = int(data["scalars"][1])
+            self.latency_log = data["latency_log"].tolist()
+            self.wall_latency_log = data["wall_latency_log"].tolist()
+            self.commit_log = [
+                [tuple(map(int, row)) for row in data[f"commit_log.{v}"]]
+                for v in range(self.cfg.num_nodes)
+            ]

@@ -1,0 +1,61 @@
+"""Lightweight tracing: named wall-clock spans + optional device
+profiler capture.
+
+Reference: profiling was ad hoc — commented-out per-message stopwatches
+in DAG.HandleMessage (DAG.cs:300-378) and offline dotnet-trace runs
+(paper §6.4). Here spans are first-class and cheap, and the device side
+defers to jax.profiler (XLA's own instrumentation) when a trace
+directory is given."""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, List, Optional
+
+
+class Tracer:
+    """Accumulates named span timings; ``report()`` -> per-span stats."""
+
+    def __init__(self) -> None:
+        self.spans: Dict[str, List[float]] = defaultdict(list)
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.spans[name].append(time.perf_counter() - t0)
+
+    def report(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for name, xs in self.spans.items():
+            n = len(xs)
+            total = sum(xs)
+            out[name] = {
+                "count": n,
+                "total_ms": round(1e3 * total, 3),
+                "mean_ms": round(1e3 * total / n, 3),
+                "max_ms": round(1e3 * max(xs), 3),
+            }
+        return out
+
+    def clear(self) -> None:
+        self.spans.clear()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture an XLA device profile into ``log_dir`` (no-op when None)
+    — view with any XProf-compatible tool."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

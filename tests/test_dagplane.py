@@ -48,7 +48,7 @@ def test_partial_frame_waits_for_more_bytes():
     assert len(decode_messages(buf)) == 1
 
 
-def _run_split(cfg, rounds, link_a_to_b, link_b_to_a):
+def _run_split(cfg, link_a_to_b, link_b_to_a):
     a = SplitClusterEndpoint(cfg, np.asarray([True, True, False, False]),
                              send=link_a_to_b)
     b = SplitClusterEndpoint(cfg, np.asarray([False, False, True, True]),
@@ -62,7 +62,7 @@ def test_split_cluster_converges_in_memory():
     prefix (the DAGServerTests liveness+agreement check)."""
     cfg = DagConfig(N, W)
     inbox_a, inbox_b = [], []
-    a, b = _run_split(cfg, 0, inbox_b.append, inbox_a.append)
+    a, b = _run_split(cfg, inbox_b.append, inbox_a.append)
     commits_a, commits_b = init_commit(cfg), init_commit(cfg)
     # a round needs ~3 message exchanges (block -> sig -> cert), so give
     # the lockstep loop enough iterations to fill the window

@@ -266,3 +266,48 @@ def test_reversible_set_bound_compensation(service):
         committed, compensated = sa.add("z")  # third: over the bound
         assert committed and compensated
         assert sa.size(stable=True) <= 2
+
+
+def test_rga_collaborative_text_over_wire():
+    """Collaborative text editing through the full client plane:
+    position-based inserts/deletes, reads of the materialized document,
+    convergence across clients on different home nodes."""
+    cfg = JanusConfig(
+        num_nodes=4, window=8, ops_per_block=8,
+        types=(TypeConfig("rga", {"num_keys": 2, "capacity": 64,
+                                  "max_depth": 16}),),
+    )
+    svc = JanusService(cfg)
+    port = svc.start()
+    try:
+        with JanusClient("127.0.0.1", port, timeout=120) as a, \
+                JanusClient("127.0.0.1", port, timeout=120) as b:
+            assert a.request("rga", "doc", "s", timeout=120)["result"] == "success"
+            b.request("rga", "doc", "s", timeout=120)
+            for i, ch in enumerate("Helo"):
+                a.request("rga", "doc", "a", [str(ord(ch)), str(i)])
+            # fix the typo: insert 'l' at index 3 -> "Hello"
+            a.request("rga", "doc", "a", [str(ord("l")), "3"])
+            assert a.request("rga", "doc", "gp", timeout=120)["result"] == "Hello"
+            # another client (different home node) appends after syncing
+            import time
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if b.request("rga", "doc", "gp", timeout=120)["result"] == "Hello":
+                    break
+                time.sleep(0.05)
+            b.request("rga", "doc", "a", [str(ord("!")), "5"])
+            deadline = time.monotonic() + 60
+            got = None
+            while time.monotonic() < deadline:
+                got = a.request("rga", "doc", "gp", timeout=120)["result"]
+                if got == "Hello!":
+                    break
+                time.sleep(0.05)
+            assert got == "Hello!"
+            # delete the 'H' (index 0)
+            a.request("rga", "doc", "r", ["0"])
+            assert a.request("rga", "doc", "gp", timeout=120)["result"] == "ello!"
+            assert a.request("rga", "doc", "sp", timeout=120)["result"] == "5"
+    finally:
+        svc.stop()
