@@ -227,7 +227,9 @@ SPEC = base.register_type(
         init=init,
         apply_ops=apply_ops,
         merge=merge,
-        queries={"vertex_count": vertex_count, "edge_count": edge_count},
+        queries={"vertex_count": vertex_count, "edge_count": edge_count,
+                 "contains_vertex": contains_vertex,
+                 "contains_edge": contains_edge},
         op_codes={"av": OP_ADD_VERTEX, "rv": OP_REMOVE_VERTEX,
                   "ae": OP_ADD_EDGE, "re": OP_REMOVE_EDGE},
         op_extras={"ok": 1},

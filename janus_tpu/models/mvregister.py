@@ -190,6 +190,12 @@ def num_values(state: State) -> jnp.ndarray:
     return jnp.sum(state["valid"], axis=-1)
 
 
+def has_value(state: State, key, v) -> jnp.ndarray:
+    """True iff ``v`` is among the key's current (concurrent) values."""
+    row = state["valid"][key] & (state["val"][key] == v)
+    return jnp.any(row, axis=-1)
+
+
 SPEC = base.register_type(
     base.CRDTTypeSpec(
         name="MVRegister",
@@ -197,7 +203,7 @@ SPEC = base.register_type(
         init=init,
         apply_ops=apply_ops,
         merge=merge,
-        queries={"num_values": num_values},
+        queries={"num_values": num_values, "has_value": has_value},
         op_codes={"w": OP_WRITE},
         op_extras={"wclock": "num_writers"},
         prepare_ops=prepare_ops,

@@ -70,7 +70,7 @@ int janus_server_poll_batch(JanusServer* s, int cap,
                             int32_t* type_id, int32_t* key_slot,
                             int32_t* op_code, uint8_t* is_safe,
                             int64_t* p0, int64_t* p1, int64_t* p2,
-                            uint64_t* client_tag);
+                            uint64_t* client_tag, int32_t* n_params);
 
 /* Number of distinct keys seen for a type (key_slot ids are dense). */
 int janus_server_key_count(JanusServer* s, int type_id);

@@ -48,6 +48,7 @@ struct Op {
   int32_t key_slot;
   int32_t op_code;
   uint8_t is_safe;
+  int32_t n_params;  // params the client actually sent (<= 3 retained)
   int64_t p[3];
   uint64_t client_tag;
 };
@@ -207,6 +208,7 @@ void JanusServer::handle_payload(uint32_t cid, const uint8_t* p, int len) {
                              ? int32_t(uint8_t(m.op_code[1])) << 8
                              : 0));
     op.is_safe = m.is_safe ? 1 : 0;
+    op.n_params = int32_t(m.params.size() < 3 ? m.params.size() : 3);
     for (size_t i = 0; i < 3 && i < m.params.size(); i++) {
       int64_t v;
       if (parse_int(m.params[i], &v)) {
@@ -359,7 +361,8 @@ extern "C" int janus_server_poll_batch(JanusServer* s, int cap,
                                        int32_t* type_id, int32_t* key_slot,
                                        int32_t* op_code, uint8_t* is_safe,
                                        int64_t* p0, int64_t* p1, int64_t* p2,
-                                       uint64_t* client_tag) {
+                                       uint64_t* client_tag,
+                                       int32_t* n_params) {
   std::lock_guard<std::mutex> lk(s->mu);
   int n = 0;
   while (n < cap && !s->queue.empty()) {
@@ -372,6 +375,7 @@ extern "C" int janus_server_poll_batch(JanusServer* s, int cap,
     p1[n] = op.p[1];
     p2[n] = op.p[2];
     client_tag[n] = op.client_tag;
+    n_params[n] = op.n_params;
     s->queue.pop_front();
     n++;
   }

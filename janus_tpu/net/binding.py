@@ -68,7 +68,8 @@ def load() -> ctypes.CDLL:
         lib.janus_server_register_type.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
         lib.janus_server_register_type.restype = c.c_int
         lib.janus_server_poll_batch.argtypes = [
-            c.c_void_p, c.c_int, i32p, i32p, i32p, u8p, i64p, i64p, i64p, u64p,
+            c.c_void_p, c.c_int, i32p, i32p, i32p, u8p, i64p, i64p, i64p,
+            u64p, i32p,
         ]
         lib.janus_server_poll_batch.restype = c.c_int
         lib.janus_server_key_count.argtypes = [c.c_void_p, c.c_int]
@@ -173,6 +174,7 @@ class NativeServer:
         p1 = np.empty(cap, np.int64)
         p2 = np.empty(cap, np.int64)
         tag = np.empty(cap, np.uint64)
+        npar = np.empty(cap, np.int32)
 
         def ptr(a, t):
             return a.ctypes.data_as(c.POINTER(t))
@@ -181,11 +183,12 @@ class NativeServer:
             self._h, cap, ptr(tid, c.c_int32), ptr(key, c.c_int32),
             ptr(opc, c.c_int32), ptr(safe, c.c_uint8), ptr(p0, c.c_int64),
             ptr(p1, c.c_int64), ptr(p2, c.c_int64), ptr(tag, c.c_uint64),
+            ptr(npar, c.c_int32),
         )
         return {
             "type_id": tid[:n], "key_slot": key[:n], "op_code": opc[:n],
             "is_safe": safe[:n], "p0": p0[:n], "p1": p1[:n], "p2": p2[:n],
-            "client_tag": tag[:n],
+            "client_tag": tag[:n], "n_params": npar[:n],
         }
 
     def key_count(self, type_id: int) -> int:

@@ -96,7 +96,7 @@ class _TypeRuntime:
     def __init__(self, cfg: JanusConfig, tcfg: TypeConfig):
         spec = base.get_type(tcfg.type_code)
         dims = dict(tcfg.dims)
-        if tcfg.type_code == "pnc":
+        if tcfg.type_code in ("pnc", "mvr"):
             dims.setdefault("num_writers", cfg.num_nodes)
         if tcfg.type_code == "rga":
             # worst-case append chains are capacity deep; default the
@@ -165,6 +165,10 @@ class JanusService:
         # ops counted at reply time (PerfCounter.cs:13-88 — the
         # reference hooks OpAdd on every client reply), plus step timing
         self.perf = PerfCounter()
+        # monotone LWW stamp mint: wall time alone can tie (same-batch
+        # pipelined ops) or step back (NTP), and add wins ties — a
+        # remove issued after an add must always stamp strictly later
+        self._lww_last_ts = 0
         self._step_ms: List[float] = []
         # reads waiting for their connection's earlier updates to board
         # a block (read-your-writes) or for their key's create to commit
@@ -252,6 +256,7 @@ class JanusService:
                 "safe": bool(polled["is_safe"][i]),
                 "p0": int(polled["p0"][i]),
                 "p1": int(polled["p1"][i]),
+                "n_params": int(polled["n_params"][i]),
             })
         reads: List[dict] = []
         for it in items:
@@ -388,6 +393,25 @@ class JanusService:
             if op_id == orset_mod.OP_ADD:
                 rep, ctr = rt.minters[home].mint()
                 f["a1"], f["a2"] = rep, ctr
+        elif code == "lww":
+            # add/remove stamp host microseconds split into int32 lanes
+            # (LWWSet.cs stamps DateTime.UtcNow at the server, :148-191),
+            # made strictly monotone across ops
+            f["a0"] = self._elem_id(p0)
+            ts = max(time.time_ns() // 1000, self._lww_last_ts + 1)
+            self._lww_last_ts = ts
+            f["a1"], f["a2"] = int(ts >> 31), int(ts & 0x7FFFFFFF)
+        elif code in ("tpset", "mvr"):
+            f["a0"] = self._elem_id(p0)
+        elif code == "graph":
+            import janus_tpu.models.graph as graph_mod
+            f["a0"] = self._elem_id(p0)
+            if op_id in (graph_mod.OP_ADD_EDGE, graph_mod.OP_REMOVE_EDGE):
+                # edges need BOTH endpoints explicitly (0 is a legal
+                # vertex id, so a missing param must not default to it)
+                if it["n_params"] < 2:
+                    return None
+                f["a1"] = self._elem_id(int(it["p1"]))
         elif code == "rga":
             # position-based text API: clients never see CRDT ids —
             # 'a' = [char_code, index], 'r' = [index]; the service
@@ -538,12 +562,27 @@ class JanusService:
         if code == "pnc":
             vals = np.asarray(q("get"))  # [N, K]
             return str(int(vals[home, slot]))
-        if code == "orset":
+        if code in ("orset", "lww", "tpset", "mvr"):
             if letters in ("sp", "ss"):
-                got = np.asarray(q("live_count"))  # [N, K]
+                sizeq = "num_values" if code == "mvr" else "live_count"
+                got = np.asarray(q(sizeq))  # [N, K]
                 return str(int(got[home, slot]))
-            elem = self._elem_id(it["p0"])
-            got = np.asarray(q("contains", slot, elem))  # [N]
+            memq = "has_value" if code == "mvr" else "contains"
+            got = np.asarray(q(memq, slot, self._elem_id(it["p0"])))  # [N]
+            return "true" if bool(got[home]) else "false"
+        if code == "graph":
+            if letters in ("sp", "ss"):
+                got = np.asarray(q("vertex_count"))  # [N, K]
+                return str(int(got[home, slot]))
+            # param COUNT picks vertex vs edge query — 0 is a legal
+            # vertex id, so the second param's value cannot be a sentinel
+            if it["n_params"] >= 2:
+                got = np.asarray(q("contains_edge", slot,
+                                   self._elem_id(it["p0"]),
+                                   self._elem_id(int(it["p1"]))))
+            else:
+                got = np.asarray(q("contains_vertex", slot,
+                                   self._elem_id(it["p0"])))
             return "true" if bool(got[home]) else "false"
         if code == "rga":
             if letters in ("sp", "ss"):

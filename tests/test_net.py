@@ -311,3 +311,49 @@ def test_rga_collaborative_text_over_wire():
             assert a.request("rga", "doc", "sp", timeout=120)["result"] == "5"
     finally:
         svc.stop()
+
+
+def test_all_types_over_wire():
+    """Every replicated type is wire-reachable: LWW-Set, 2P-Set,
+    MVRegister, 2P2P-Graph — beyond the reference's pnc|orset surface
+    (CommandController.cs:13-26 registers only those two)."""
+    cfg = JanusConfig(
+        num_nodes=4, window=8, ops_per_block=8,
+        types=(TypeConfig("lww", {"num_keys": 4, "capacity": 16}),
+               TypeConfig("tpset", {"num_keys": 4, "capacity": 16}),
+               TypeConfig("mvr", {"num_keys": 4, "capacity": 8}),
+               TypeConfig("graph", {"num_keys": 4, "v_capacity": 16,
+                                    "e_capacity": 16})),
+    )
+    svc = JanusService(cfg)
+    port = svc.start()
+    try:
+        with JanusClient("127.0.0.1", port, timeout=420) as c:
+            # LWW: add then remove later wins
+            c.request("lww", "s1", "s", timeout=420)
+            c.request("lww", "s1", "a", ["7"])
+            assert c.request("lww", "s1", "gp", ["7"], timeout=420)["result"] == "true"
+            c.request("lww", "s1", "r", ["7"])
+            assert c.request("lww", "s1", "gp", ["7"], timeout=420)["result"] == "false"
+            # 2P: removed elements stay removed
+            c.request("tpset", "s2", "s", timeout=420)
+            c.request("tpset", "s2", "a", ["3"])
+            c.request("tpset", "s2", "r", ["3"])
+            c.request("tpset", "s2", "a", ["3"])  # no re-add in 2P
+            assert c.request("tpset", "s2", "gp", ["3"], timeout=420)["result"] == "false"
+            # MVRegister: single writer -> one value
+            c.request("mvr", "reg", "s", timeout=420)
+            c.request("mvr", "reg", "w", ["42"])
+            assert c.request("mvr", "reg", "gp", ["42"], timeout=420)["result"] == "true"
+            assert c.request("mvr", "reg", "sp", timeout=420)["result"] == "1"
+            # Graph: vertices then an edge; removing an anchored vertex fails
+            c.request("graph", "g", "s", timeout=420)
+            c.request("graph", "g", "av", ["1"])
+            c.request("graph", "g", "av", ["2"])
+            c.request("graph", "g", "ae", ["1", "2"])
+            assert c.request("graph", "g", "gp", ["1", "2"], timeout=420)["result"] == "true"
+            c.request("graph", "g", "rv", ["1"])  # blocked: incident edge
+            assert c.request("graph", "g", "gp", ["1"], timeout=420)["result"] == "true"
+            assert c.request("graph", "g", "sp", timeout=420)["result"] == "2"
+    finally:
+        svc.stop()
