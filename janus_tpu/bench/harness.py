@@ -51,6 +51,13 @@ class BenchConfig:
     zipf_theta: float = 0.99
     byzantine: int = 0                # nodes injecting invalid signatures
     invalid_rate: float = 0.5
+    # OR-Set per-key tag capacity. NOT scaled with num_objects: the
+    # effect-capture payload is [W, N, B, rm_capacity] int32 per extra
+    # field, so these multiply the whole consensus op buffer
+    orset_capacity: int = 128
+    # captured tags per remove op; exact while elements keep fewer live
+    # tags than this (the bench add/remove mix keeps ~1-2)
+    orset_rm_capacity: int = 16
     seed: int = 0
 
     @classmethod
@@ -171,8 +178,10 @@ def run_tensor(cfg: BenchConfig) -> Results:
                                     num_keys=K, num_writers=n)))
     if cfg.type_code in ("orset", "mixed"):
         specs.append(("orset", SafeKV(dag, orset.SPEC, ops_per_block=B,
-                                      collect_logs=False,
-                                      num_keys=K, capacity=4 * K)))
+                                      collect_logs=False, num_keys=K,
+                                      apply_budget=2 * n,
+                                      capacity=cfg.orset_capacity,
+                                      rm_capacity=cfg.orset_rm_capacity)))
     minters = [TagMinter(v) for v in range(n)]
 
     def gen_batch(code: str) -> dict:
@@ -271,6 +280,9 @@ def run_tensor(cfg: BenchConfig) -> Results:
     res.extra["commit_lag_ticks_p50"] = (
         int(np.percentile(np.concatenate([
             np.asarray(kv.latency_log) for _, kv, _ in specs]), 50)))
+    # every counted op is applied at all n emulated nodes (the reference
+    # counts one application per real machine per op the same way)
+    res.extra["replica_applications_per_sec"] = round(res.throughput * n, 1)
     return res
 
 
@@ -290,7 +302,7 @@ def run_wire(cfg: BenchConfig) -> Results:
         tcs.append(TypeConfig("pnc", {"num_keys": cfg.num_objects}))
     if cfg.type_code in ("orset", "mixed"):
         tcs.append(TypeConfig("orset", {"num_keys": cfg.num_objects,
-                                        "capacity": 4 * cfg.num_objects}))
+                                        "capacity": cfg.orset_capacity}))
     svc = JanusService(JanusConfig(
         num_nodes=cfg.num_nodes, window=cfg.window,
         ops_per_block=max(64, cfg.ops_per_client // 4), types=tuple(tcs)))
@@ -432,12 +444,12 @@ PRESETS = {
     "pnc": BenchConfig(name="pnc_4rep_banking_shape", type_code="pnc",
                        num_nodes=4, num_objects=100, ops_ratio=(0.2, 0.6, 0.2)),
     "orset": BenchConfig(name="orset_16rep", type_code="orset", num_nodes=16,
-                         window=8, num_objects=1000, ops_per_block=500,
+                         window=8, num_objects=1000, ops_per_block=512,
                          ops_ratio=(0.0, 1.0, 0.0)),
     "mixed": BenchConfig(name="mixed_zipf_64rep", type_code="mixed",
                          num_nodes=64, window=8, num_objects=1000,
-                         ops_per_block=256, key_pattern="zipf",
-                         ops_ratio=(0.3, 0.5, 0.2)),
+                         ops_per_block=128, key_pattern="zipf",
+                         orset_capacity=64, ops_ratio=(0.3, 0.5, 0.2)),
     "byzantine": BenchConfig(name="byzantine_orset", type_code="orset",
                              num_nodes=16, num_objects=500, ops_per_block=256,
                              byzantine=4, invalid_rate=0.25,

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 from typing import Dict
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -41,14 +42,25 @@ KEY_FIELDS = ("tag_rep", "tag_ctr")
 State = Dict[str, jnp.ndarray]  # fields [..., K, C]; "valid" mask included
 
 
-def init(num_keys: int, capacity: int) -> State:
-    return make_slots(
+def init(num_keys: int, capacity: int,
+         rm_capacity: int | None = None) -> State:
+    """``rm_capacity`` bounds how many observed tags one remove/clear op
+    captures (defaults to ``capacity`` = exact observed-remove
+    semantics). Workloads that keep few live tags per element can size
+    it down — the captured payload is [B, rm_capacity] per extra field
+    and dominates the consensus op buffer. A remove observing more
+    matching tags than rm_capacity tombstones only the first
+    rm_capacity in canonical tag order (partial remove)."""
+    st = make_slots(
         capacity,
         {"tag_rep": jnp.int32, "tag_ctr": jnp.int32, "elem": jnp.int32,
          "removed": jnp.bool_},
         batch=(num_keys,),
         key_fields=KEY_FIELDS,
     )
+    r = capacity if rm_capacity is None else int(rm_capacity)
+    st["_rm_cap"] = jnp.zeros((r, 0), jnp.int32)  # static width carrier
+    return st
 
 
 def _combine(p, q):
@@ -81,16 +93,159 @@ def prepare_ops(state: State, ops: base.OpBatch) -> base.OpBatch:
     is_cl = ops["op"] == OP_CLEAR
     sel = rows_valid & jnp.where(is_rm[:, None], rows_elem == ops["a0"][:, None], True)
     sel = sel & (is_rm | is_cl)[:, None]
+    # compact to the capture width: selected tags first (stable, so
+    # canonical tag order is preserved), then slice
+    r_cap = state["_rm_cap"].shape[-2]
+    srt = lax.sort(((~sel).astype(jnp.int32),
+                    jnp.where(sel, rows_rep, SENTINEL),
+                    jnp.where(sel, rows_ctr, SENTINEL),
+                    jnp.where(sel, rows_elem, 0)),
+                   dimension=-1, num_keys=1, is_stable=True)
     return {
         **ops,
-        "rm_rep": jnp.where(sel, rows_rep, SENTINEL),
-        "rm_ctr": jnp.where(sel, rows_ctr, SENTINEL),
-        "rm_elem": jnp.where(sel, rows_elem, 0),
+        "rm_rep": srt[1][..., :r_cap],
+        "rm_ctr": srt[2][..., :r_cap],
+        "rm_elem": srt[3][..., :r_cap],
+    }
+
+
+def _canonical_row(row):
+    """Sort one [C] row by tag (invalid slots last, SENTINEL keys, zero
+    payloads) — the same layout slot_union emits. Every apply path keeps
+    rows canonical, so states that are set-equal are bit-equal tensors
+    regardless of which path (origin capture, batched replay, host
+    scan) produced them."""
+    valid = row["valid"]
+    rep = jnp.where(valid, row["tag_rep"], SENTINEL)
+    ctr = jnp.where(valid, row["tag_ctr"], SENTINEL)
+    srt = lax.sort(
+        (rep, ctr, valid, jnp.where(valid, row["elem"], 0),
+         row["removed"] & valid),
+        dimension=-1, num_keys=2, is_stable=True)
+    return {"tag_rep": srt[0], "tag_ctr": srt[1], "valid": srt[2],
+            "elem": srt[3], "removed": srt[4]}
+
+
+def _apply_captured_batch(state: State, ops: base.OpBatch) -> State:
+    """Batched replay of effect-captured ops: ONE global sort instead of
+    a per-op scan of slot unions. Captured ops commute (adds carry fixed
+    tags, removes/clears carry their observed tag sets), so the whole
+    batch folds as a single set union:
+
+        records = state slots + add records + captured tombstone records
+        sort by (key, tag) -> segment-fold duplicates (tombstone OR)
+        -> scatter back per key in canonical order
+
+    Cost: one sort of K*C + B*(C+1) records — the consensus delta-apply
+    hot path (a budget of blocks x B ops per tick would otherwise run
+    thousands of small sequential sorts). Slots beyond a key's capacity
+    are dropped silently, like row_insert on a full row."""
+    K, C = state["elem"].shape[-2], state["elem"].shape[-1]
+    B = ops["op"].shape[0]
+    R = ops["rm_rep"].shape[-1]  # capture width (rm_capacity)
+    en = ops["op"] != base.OP_NOOP
+    is_add = en & (ops["op"] == OP_ADD)
+    is_tomb = en & ((ops["op"] == OP_REMOVE) | (ops["op"] == OP_CLEAR))
+
+    # record soup: (key, rep, ctr, elem, removed, valid)
+    st_key = jnp.broadcast_to(jnp.arange(K)[:, None], (K, C)).reshape(-1)
+    key = jnp.concatenate([
+        st_key, ops["key"],
+        jnp.broadcast_to(ops["key"][:, None], (B, R)).reshape(-1)])
+    rep = jnp.concatenate([state["tag_rep"].reshape(-1), ops["a1"],
+                           ops["rm_rep"].reshape(-1)])
+    ctr = jnp.concatenate([state["tag_ctr"].reshape(-1), ops["a2"],
+                           ops["rm_ctr"].reshape(-1)])
+    elem = jnp.concatenate([state["elem"].reshape(-1), ops["a0"],
+                            ops["rm_elem"].reshape(-1)])
+    rm = jnp.concatenate([state["removed"].reshape(-1),
+                          jnp.zeros((B,), bool), jnp.ones((B * R,), bool)])
+    valid = jnp.concatenate([
+        state["valid"].reshape(-1), is_add,
+        ((ops["rm_rep"] != SENTINEL) & is_tomb[:, None]).reshape(-1)])
+    T = key.shape[0]
+
+    # canonicalize invalid records to sort last
+    key = jnp.where(valid, key, K)
+    rep = jnp.where(valid, rep, SENTINEL)
+    ctr = jnp.where(valid, ctr, SENTINEL)
+    # argsort by (key, rep, ctr) as three stable single-key passes,
+    # least-significant key first (LSD radix over stable sorts) — a
+    # multi-operand multi-key lax.sort compiles ~5x slower on TPU for
+    # the same runtime, and int64 key packing is unavailable (JAX
+    # canonicalizes int64 to int32 without x64)
+    idx = jnp.arange(T, dtype=jnp.int32)
+    _, idx = lax.sort((ctr, idx), dimension=-1, num_keys=1, is_stable=True)
+    _, idx = lax.sort((rep[idx], idx), dimension=-1, num_keys=1,
+                      is_stable=True)
+    _, idx = lax.sort((key[idx], idx), dimension=-1, num_keys=1,
+                      is_stable=True)
+    key, rep, ctr = key[idx], rep[idx], ctr[idx]
+    valid, elem, rm = valid[idx], elem[idx], rm[idx] & valid[idx]
+
+    # segment-fold duplicate tags (a tag can appear 3+ times: state +
+    # add + several captured removes). All copies of a tag carry the
+    # same elem by construction, so only the tombstone bit needs a
+    # segment reduction — a segmented suffix-OR via associative_scan
+    # (log-depth; a scatter-based segment_max would dominate the tick)
+    first = jnp.ones((T,), bool).at[1:].set(
+        (key[1:] != key[:-1]) | (rep[1:] != rep[:-1]) | (ctr[1:] != ctr[:-1]))
+
+    # segment reductions via cumulative primitives (exact and
+    # compile-cheap; a multi-operand segmented scan compiles an order
+    # of magnitude slower and naive pointer-doubling leaks across
+    # segment boundaries):
+    #   tombstone OR over a tag segment  = windowed cumsum difference
+    #   rank offset within a key group   = excl at the group's start
+    idx_arr = jnp.arange(T, dtype=jnp.int32)
+    rm_int = rm.astype(jnp.int32)
+    csum = jnp.cumsum(rm_int)            # inclusive
+    csum_prev = csum - rm_int            # exclusive
+    # next segment start strictly after i  ->  this segment's end
+    nxt_first = lax.cummin(jnp.where(first, idx_arr, T), reverse=True)
+    seg_end = jnp.concatenate(
+        [nxt_first[1:], jnp.asarray([T], jnp.int32)]) - 1
+    rm_k = (csum[jnp.clip(seg_end, 0, T - 1)] - csum_prev) > 0
+    keep = valid & first
+
+    # rank among kept records within each key group -> output slot
+    inc = keep.astype(jnp.int32)
+    excl = jnp.cumsum(inc) - inc  # exclusive prefix count of kept
+    key_first = jnp.ones((T,), bool).at[1:].set(key[1:] != key[:-1])
+    last_kfirst = lax.cummax(jnp.where(key_first, idx_arr, 0))
+    rank = excl - excl[last_kfirst]
+    ok = keep & (rank < C) & (key < K)
+
+    # ONE unique-index scatter of packed records: duplicate dump cells
+    # would serialize the scatter, and five separate scatters pay the
+    # index cost five times. flags word: bit0 removed, bit1 valid.
+    d = jnp.where(ok, key * C + rank, K * C + jnp.arange(T, dtype=jnp.int32))
+    packed = jnp.stack([
+        jnp.where(ok, rep, SENTINEL),
+        jnp.where(ok, ctr, SENTINEL),
+        jnp.where(ok, elem, 0),
+        (ok & rm_k).astype(jnp.int32) + 2 * ok.astype(jnp.int32),
+    ], axis=-1)  # [T, 4]
+    buf = jnp.concatenate([
+        jnp.tile(jnp.asarray([SENTINEL, SENTINEL, 0, 0], jnp.int32),
+                 (K * C, 1)),
+        jnp.zeros((T, 4), jnp.int32),
+    ])
+    buf = buf.at[d].set(packed)[: K * C].reshape(K, C, 4)
+    return {
+        "tag_rep": buf[..., 0],
+        "tag_ctr": buf[..., 1],
+        "elem": buf[..., 2],
+        "removed": (buf[..., 3] & 1).astype(bool),
+        "valid": (buf[..., 3] >= 2),
+        "_rm_cap": state["_rm_cap"],
     }
 
 
 def apply_ops(state: State, ops: base.OpBatch) -> State:
-    """Apply add/remove/clear ops sequentially (lax.scan) — adds need a
+    """Apply add/remove/clear ops. Captured batches (the consensus
+    replay path) fold as one batched set union; otherwise ops apply
+    sequentially (lax.scan) — adds need a
     fresh slot each, so within-batch ordering matters, exactly like the
     reference's per-object lock serialization (ORSetCommand.cs).
 
@@ -104,12 +259,17 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
             (host-direct use), tombstones whatever matching tags are
             locally present at apply time.
     clear:  same, over every observed tag.
+
+    Every path returns the CANONICAL row layout (see _canonical), so
+    origin-applied and replay-applied states are bit-comparable.
     """
     has_capture = "rm_rep" in ops
+    if has_capture and int(ops["op"].shape[0]) > 1:
+        return _apply_captured_batch(state, ops)
 
     def step(st, op):
         k = op["key"]
-        row = {f: st[f][k] for f in st}
+        row = {f: st[f][k] for f in st if f != "_rm_cap"}
         en = op["op"] != base.OP_NOOP
         is_tomb = en & ((op["op"] == OP_REMOVE) | (op["op"] == OP_CLEAR))
 
@@ -153,7 +313,12 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
             )
             new_row = {f: added[f] for f in row}
             new_row["removed"] = added["removed"] | tomb
-        st = {f: st[f].at[k].set(new_row[f]) for f in st}
+        # canonicalize only the touched row (untouched rows stay
+        # canonical by induction; a full-state sort per scanned op
+        # would dominate the submit path)
+        new_row = _canonical_row(new_row)
+        st = {f: (st[f] if f == "_rm_cap" else st[f].at[k].set(new_row[f]))
+              for f in st}
         return st, None
 
     state, _ = lax.scan(step, state, ops)
@@ -168,7 +333,11 @@ def merge(a: State, b: State) -> State:
 def merge_with_stats(a: State, b: State):
     """Join = per-key union of tag slots; returns (state, overflow[..., K])."""
     cap = a["tag_rep"].shape[-1]
-    return slot_union(a, b, KEY_FIELDS, _combine, capacity=cap)
+    sa = {f: v for f, v in a.items() if f != "_rm_cap"}
+    sb = {f: v for f, v in b.items() if f != "_rm_cap"}
+    out, overflow = slot_union(sa, sb, KEY_FIELDS, _combine, capacity=cap)
+    out["_rm_cap"] = a["_rm_cap"]
+    return out, overflow
 
 
 def contains(state: State, key, elem) -> jnp.ndarray:
@@ -213,7 +382,8 @@ def compact(state: State) -> State:
     )
     del rank_s
     return {"tag_rep": rep, "tag_ctr": ctr, "elem": elem,
-            "removed": removed, "valid": valid}
+            "removed": removed, "valid": valid,
+            "_rm_cap": state["_rm_cap"]}
 
 
 SPEC = base.register_type(
@@ -226,8 +396,8 @@ SPEC = base.register_type(
         queries={"contains": contains, "live_count": live_count},
         # wire opCodes: a=add, r=remove, c=clear (ORSetCommand.cs:13-87)
         op_codes={"a": OP_ADD, "r": OP_REMOVE, "c": OP_CLEAR},
-        op_extras={"rm_rep": "capacity", "rm_ctr": "capacity",
-                   "rm_elem": "capacity"},
+        op_extras={"rm_rep": "rm_capacity", "rm_ctr": "rm_capacity",
+                   "rm_elem": "rm_capacity"},
         prepare_ops=prepare_ops,
     )
 )
