@@ -443,13 +443,20 @@ PRESETS = {
     # BASELINE.json configs 1-4 (config 5, RGA, lives with the sequence type)
     "pnc": BenchConfig(name="pnc_4rep_banking_shape", type_code="pnc",
                        num_nodes=4, num_objects=100, ops_ratio=(0.2, 0.6, 0.2)),
+    # capacity sized to hold the run's full add volume (~4 adds/key/
+    # tick over ticks+warmup) — tombstones are never compacted mid-run,
+    # and silent slot overflow would fake healthy numbers
     "orset": BenchConfig(name="orset_16rep", type_code="orset", num_nodes=16,
                          window=8, num_objects=1000, ops_per_block=512,
+                         ticks=32, orset_capacity=256,
                          ops_ratio=(0.0, 1.0, 0.0)),
+    # 64-node two-type emulation: all 64 views' unions run on one chip,
+    # so the tick is heavy — sized for a ~5-minute run
     "mixed": BenchConfig(name="mixed_zipf_64rep", type_code="mixed",
-                         num_nodes=64, window=8, num_objects=1000,
-                         ops_per_block=128, key_pattern="zipf",
-                         orset_capacity=64, ops_ratio=(0.3, 0.5, 0.2)),
+                         num_nodes=64, window=8, num_objects=500,
+                         ops_per_block=64, ticks=24, key_pattern="zipf",
+                         orset_capacity=256, orset_rm_capacity=8,
+                         ops_ratio=(0.3, 0.5, 0.2)),
     "byzantine": BenchConfig(name="byzantine_orset", type_code="orset",
                              num_nodes=16, num_objects=500, ops_per_block=256,
                              byzantine=4, invalid_rate=0.25,

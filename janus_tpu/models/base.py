@@ -93,6 +93,10 @@ class CRDTTypeSpec:
     # the tensor analog of the reference shipping full state snapshots
     # instead of operations (ReplicationManager.cs:347-357).
     op_extras: Dict[str, str | int] = dataclasses.field(default_factory=dict)
+    # dim-name defaults for op_extras resolution: a capture-width dim
+    # callers may omit falls back to another dim (e.g. OR-Set
+    # rm_capacity -> capacity)
+    dim_defaults: Dict[str, str] = dataclasses.field(default_factory=dict)
     prepare_ops: Callable[[Any, OpBatch], OpBatch] | None = None
     # Replay safety: True iff apply_ops is a pure function of (state, op
     # data) whose replicated replay converges under any certify/commit

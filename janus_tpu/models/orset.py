@@ -27,12 +27,11 @@ from __future__ import annotations
 
 from typing import Dict
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
 from janus_tpu.models import base
-from janus_tpu.ops import SENTINEL, make_slots, row_upsert, slot_union
+from janus_tpu.ops import SENTINEL, make_slots, row_find, slot_union
 
 OP_ADD = 1    # reference opId 1 = Add (ORSetWrapper.cs:30-47)
 OP_REMOVE = 2
@@ -273,20 +272,35 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
         en = op["op"] != base.OP_NOOP
         is_tomb = en & ((op["op"] == OP_REMOVE) | (op["op"] == OP_CLEAR))
 
-        # Upsert, not insert: the tag may already be present as a
-        # tombstone record (a captured remove that arrived first) — the
-        # removed bit is sticky, so a late add lands dead instead of
-        # duplicating the key (idempotent re-delivery also folds here).
-        added = row_upsert(
-            row,
-            KEY_FIELDS,
-            (op["a1"], op["a2"]),
-            {"elem": op["a0"], "removed": jnp.bool_(False)},
-            combine_existing=lambda old, new: {
-                "elem": new["elem"], "removed": old["removed"]
-            },
-            enabled=en & (op["op"] == OP_ADD),
-        )
+        # Upsert with keep-smallest-C overflow: if the tag exists
+        # (e.g. as a tombstone record from a captured remove that
+        # arrived first) fold into it — the removed bit is sticky, so a
+        # late add lands dead. Otherwise append the record and keep the
+        # C smallest tags; a full row evicts the LARGEST tag (which may
+        # be the newcomer). The batched replay path applies the same
+        # policy, so origin and replay states stay bit-equal even at
+        # capacity (drop-on-full here with keep-smallest there would
+        # diverge replicas permanently on the first full row).
+        do_add = en & (op["op"] == OP_ADD)
+        found, fidx = row_find(row, KEY_FIELDS, (op["a1"], op["a2"]))
+        folded = dict(row)
+        folded["elem"] = row["elem"].at[fidx].set(op["a0"])
+        appended = {
+            "tag_rep": jnp.concatenate([row["tag_rep"], op["a1"][None]]),
+            "tag_ctr": jnp.concatenate([row["tag_ctr"], op["a2"][None]]),
+            "elem": jnp.concatenate([row["elem"], op["a0"][None]]),
+            "removed": jnp.concatenate(
+                [row["removed"], jnp.zeros((1,), bool)]),
+            "valid": jnp.concatenate([row["valid"], jnp.ones((1,), bool)]),
+        }
+        appended = {f: v[..., : row["valid"].shape[-1]]
+                    for f, v in _canonical_row(appended).items()}
+        added = {
+            f: jnp.where(do_add,
+                         jnp.where(found, folded[f], appended[f]),
+                         row[f])
+            for f in row
+        }
         if has_capture:
             # tombstone-record union: captured tags fold into existing
             # slots (removed |= True) or insert as dead slots
@@ -398,6 +412,7 @@ SPEC = base.register_type(
         op_codes={"a": OP_ADD, "r": OP_REMOVE, "c": OP_CLEAR},
         op_extras={"rm_rep": "rm_capacity", "rm_ctr": "rm_capacity",
                    "rm_elem": "rm_capacity"},
+        dim_defaults={"rm_capacity": "capacity"},
         prepare_ops=prepare_ops,
     )
 )

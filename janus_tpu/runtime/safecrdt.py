@@ -115,10 +115,9 @@ class SafeKV:
         # width against the type dims (+ the cluster size), or are
         # literal ints
         dim_env = {**dims, "num_nodes": n}
-        # capture-width dims may default to their state-capacity dim
-        # (e.g. OR-Set rm_capacity -> capacity) when callers omit them
-        if "rm_capacity" not in dim_env and "capacity" in dim_env:
-            dim_env["rm_capacity"] = dim_env["capacity"]
+        for target, source in spec.dim_defaults.items():
+            if target not in dim_env and source in dim_env:
+                dim_env[target] = dim_env[source]
         self.extra_widths = {
             name: (int(dim_env[dim]) if isinstance(dim, str) else int(dim))
             for name, dim in spec.op_extras.items()

@@ -202,3 +202,42 @@ def test_every_registered_type_is_replay_safe():
         assert spec.replay_safe or spec.prepare_ops is not None, (
             f"type {code} is neither replay_safe nor effect-captured"
         )
+
+
+def test_orset_batched_replay_matches_scan_path():
+    """The batched captured-union replay (consensus delta apply) must be
+    bit-equal to per-op scan application of the same captured ops —
+    including at row capacity, where both paths keep the C smallest tags
+    (a policy mismatch would silently diverge origin from replicas)."""
+    import jax.numpy as jnp
+
+    from janus_tpu.models import base, orset
+
+    K, C = 3, 4
+    st0 = orset.init(num_keys=K, capacity=C)
+    # ops: fill key 0 past capacity, interleave removes
+    raw = base.make_op_batch(
+        op=np.asarray([1, 1, 1, 1, 2, 1, 1], np.int32),
+        key=np.asarray([0, 0, 0, 0, 0, 0, 1], np.int32),
+        a0=np.asarray([7, 7, 8, 8, 7, 9, 5], np.int32),
+        a1=np.asarray([5, 6, 7, 8, 0, 1, 2], np.int32),
+        a2=np.asarray([1, 1, 1, 1, 0, 1, 1], np.int32))
+    # origin-style sequential capture produces the canonical op stream
+    _, captured = base.capture_and_apply(orset.SPEC, st0, raw)
+
+    # scan path: one op at a time
+    st_scan = st0
+    for i in range(7):
+        one = {f: v[i][None] for f, v in captured.items()}
+        st_scan = orset.apply_ops(st_scan, one)
+    # batched path: whole stream at once
+    st_batch = orset.apply_ops(st0, captured)
+    for f in ("tag_rep", "tag_ctr", "elem", "removed", "valid"):
+        np.testing.assert_array_equal(np.asarray(st_scan[f]),
+                                      np.asarray(st_batch[f]), err_msg=f)
+    # and grouping-insensitive: two halves applied separately
+    st_half = orset.apply_ops(st0, {f: v[:4] for f, v in captured.items()})
+    st_half = orset.apply_ops(st_half, {f: v[4:] for f, v in captured.items()})
+    for f in ("tag_rep", "tag_ctr", "elem", "removed", "valid"):
+        np.testing.assert_array_equal(np.asarray(st_half[f]),
+                                      np.asarray(st_batch[f]), err_msg=f)
