@@ -16,7 +16,10 @@ int put_varint(uint64_t v, uint8_t* out) {
   return n;
 }
 
-// returns bytes consumed, 0 if incomplete
+// returns bytes consumed, 0 if incomplete, -1 if malformed (a varint
+// that still has a continuation bit after 10 bytes can never terminate
+// validly — treating it as "incomplete" would make the caller buffer
+// that connection's bytes forever)
 int get_varint(const uint8_t* buf, int len, uint64_t* out) {
   uint64_t v = 0;
   for (int i = 0; i < len && i < 10; i++) {
@@ -26,34 +29,26 @@ int get_varint(const uint8_t* buf, int len, uint64_t* out) {
       return i + 1;
     }
   }
-  return 0;
+  return len >= 10 ? -1 : 0;
 }
 
 }  // namespace
 
-extern "C" int janus_frame_encode(const uint8_t* payload, int len, int field,
-                                  uint8_t* out, int out_cap) {
-  uint8_t hdr[12];
-  int h = 0;
-  h += put_varint(uint64_t(field) << 3 | 2, hdr + h);
-  h += put_varint(uint64_t(len), hdr + h);
-  if (h + len > out_cap) return -1;
-  for (int i = 0; i < h; i++) out[i] = hdr[i];
-  for (int i = 0; i < len; i++) out[h + i] = payload[i];
-  return h + len;
-}
-
-extern "C" int janus_frame_decode(const uint8_t* buf, int len, int* off,
-                                  int* plen) {
-  uint64_t tag = 0, n = 0;
-  int a = get_varint(buf, len, &tag);
+// Field-0 framing: a bare varint length with NO header tag — the exact
+// bytes protobuf-net's 3-arg SerializeWithLengthPrefix(stream, msg,
+// PrefixStyle.Base128) emits (fieldNumber=0), which is what the
+// reference client/server pair speaks on the client plane
+// (ServerConnection.cs:51, ClientInterface.cs:56,202). The DAG plane's
+// tagged subtype framing is encoded/decoded in Python (net/dagplane.py).
+extern "C" int janus_frame_decode0(const uint8_t* buf, int len, int* off,
+                                   int* plen) {
+  uint64_t n = 0;
+  int a = get_varint(buf, len, &n);
   if (a == 0) return 0;
-  if ((tag & 7) != 2) return -1;  // only length-delimited frames
-  int b = get_varint(buf + a, len - a, &n);
-  if (b == 0) return 0;
+  if (a < 0) return -1;
   if (n > uint64_t(1) << 30) return -2;  // 1 GiB sanity cap
-  if (a + b + int(n) > len) return 0;    // incomplete
-  *off = a + b;
+  if (a + int(n) > len) return 0;        // incomplete
+  *off = a;
   *plen = int(n);
-  return a + b + int(n);
+  return a + int(n);
 }

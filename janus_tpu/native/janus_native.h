@@ -41,11 +41,11 @@ int janus_ecdsa_verify(const uint8_t* pub_der, int pub_len,
 
 /* ---- varint framing (Base128 length prefix, protobuf-net compatible
  * shape: tag byte (field<<3|2), varint length, payload) ---- */
-int janus_frame_encode(const uint8_t* payload, int len, int field,
-                       uint8_t* out, int out_cap);
-/* Returns bytes consumed, 0 if incomplete, negative on malformed.
- * Writes payload offset/length into *off and *plen. */
-int janus_frame_decode(const uint8_t* buf, int len, int* off, int* plen);
+/* Field-0 framing (bare varint length, no tag) — protobuf-net's 3-arg
+ * SerializeWithLengthPrefix convention; the client plane speaks this.
+ * Returns bytes consumed, 0 if incomplete, negative on malformed;
+ * writes payload offset/length into *off and *plen. */
+int janus_frame_decode0(const uint8_t* buf, int len, int* off, int* plen);
 
 /* ---- client-interface server ---- */
 typedef struct JanusServer JanusServer;
@@ -75,10 +75,23 @@ int janus_server_poll_batch(JanusServer* s, int cap,
 /* Number of distinct keys seen for a type (key_slot ids are dense). */
 int janus_server_key_count(JanusServer* s, int type_id);
 
-/* Send a reply frame for a drained op. result/response are strings
- * (reference ClientMessage.result/.response). Returns 0 on success. */
-int janus_server_reply(JanusServer* s, uint64_t client_tag,
-                       const char* result, const char* response);
+/* Send a reply frame for a drained op, protobuf-net shaped like the
+ * reference's (ClientMessage.result is a BOOL, field 8; the value or
+ * error text rides .response, a string, field 9 —
+ * ClientInterface.CreateResponse, ClientInterface.cs:304-323).
+ * Returns 0 on success. */
+int janus_server_reply(JanusServer* s, uint64_t client_tag, int ok,
+                       const char* response);
+
+/* Batched replies: one frame build + one send per DISTINCT connection
+ * for the whole batch (the per-reply dup/send/close syscall triple
+ * otherwise dominates the wire plane at high op rates). response_off is
+ * n+1 offsets into response_buf (reply i's text is
+ * response_buf[response_off[i] : response_off[i+1]]).
+ * Returns the number of replies delivered. */
+int janus_server_reply_batch(JanusServer* s, int n, const uint64_t* tags,
+                             const uint8_t* ok, const uint8_t* response_buf,
+                             const int32_t* response_off);
 
 /* Counters for observability (PerfCounter analog, Utlis/PerfCounter.cs). */
 long long janus_server_ops_received(JanusServer* s);

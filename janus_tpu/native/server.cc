@@ -62,6 +62,7 @@ struct TypeSpace {
   std::string code;
   int capacity;
   std::unordered_map<std::string, int32_t> keys;
+  std::vector<std::string> key_names;  // slot -> name (reverse table)
 };
 
 int put_varint(uint64_t v, std::vector<uint8_t>& out) {
@@ -73,12 +74,6 @@ int put_varint(uint64_t v, std::vector<uint8_t>& out) {
     n++;
   } while (v);
   return n;
-}
-
-void put_str(int field, const std::string& s, std::vector<uint8_t>& out) {
-  put_varint(uint64_t(field) << 3 | 2, out);
-  put_varint(s.size(), out);
-  out.insert(out.end(), s.begin(), s.end());
 }
 
 void put_uint(int field, uint64_t v, std::vector<uint8_t>& out) {
@@ -168,6 +163,7 @@ struct JanusServer {
   uint32_t next_conn_id = 1;
   std::vector<TypeSpace> types;
   std::unordered_map<std::string, int32_t> values;  // param interner
+  std::vector<std::string> value_names;             // id -> param string
   std::atomic<long long> ops_in{0}, replies_out{0};
 
   int type_id_of(const std::string& code) {
@@ -198,6 +194,7 @@ void JanusServer::handle_payload(uint32_t cid, const uint8_t* p, int len) {
       if (int(ts.keys.size()) >= ts.capacity) return;  // keyspace full
       slot = int32_t(ts.keys.size());
       ts.keys.emplace(m.key, slot);
+      ts.key_names.push_back(m.key);
     }
     op.type_id = tid;
     op.key_slot = slot;
@@ -221,6 +218,7 @@ void JanusServer::handle_payload(uint32_t cid, const uint8_t* p, int len) {
         } else {
           vid = int32_t(values.size());
           values.emplace(m.params[i], vid);
+          value_names.push_back(m.params[i]);
         }
         op.p[i] = int64_t(uint64_t(vid) | kInternBit);
       }
@@ -279,12 +277,13 @@ void JanusServer::io_loop() {
         buf = &it->second.inbuf;
         buf->insert(buf->end(), tmp, tmp + n);
       }
-      // frame extraction (buffer only touched by this thread)
+      // frame extraction (buffer only touched by this thread); field-0
+      // framing = bare varint length, the protobuf-net client convention
       int off = 0;
       while (true) {
         int poff, plen;
-        int used = janus_frame_decode(buf->data() + off, int(buf->size()) - off,
-                                      &poff, &plen);
+        int used = janus_frame_decode0(buf->data() + off,
+                                       int(buf->size()) - off, &poff, &plen);
         if (used <= 0) {
           if (used < 0) off = int(buf->size());  // malformed: drop buffer
           break;
@@ -388,43 +387,113 @@ extern "C" int janus_server_key_count(JanusServer* s, int type_id) {
   return int(s->types[size_t(type_id)].keys.size());
 }
 
-extern "C" int janus_server_reply(JanusServer* s, uint64_t client_tag,
-                                  const char* result, const char* response) {
+namespace {
+int copy_name(const std::string& name, char* out, int cap) {
+  if (int(name.size()) + 1 > cap) return -2;
+  memcpy(out, name.data(), name.size());
+  out[name.size()] = '\0';
+  return int(name.size());
+}
+}  // namespace
+
+extern "C" int janus_server_key_name(JanusServer* s, int type_id, int slot,
+                                     char* out, int cap) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (type_id < 0 || type_id >= int(s->types.size())) return -1;
+  const auto& names = s->types[size_t(type_id)].key_names;
+  if (slot < 0 || slot >= int(names.size())) return -1;
+  return copy_name(names[size_t(slot)], out, cap);
+}
+
+extern "C" int janus_server_value_name(JanusServer* s, int value_id,
+                                       char* out, int cap) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (value_id < 0 || value_id >= int(s->value_names.size())) return -1;
+  return copy_name(s->value_names[size_t(value_id)], out, cap);
+}
+
+namespace {
+
+// Reply payload exactly as the reference shapes it (CreateResponse,
+// ClientInterface.cs:304-323): seq (field 2, varint), result (field 8,
+// BOOL varint), response (field 9, string) — framed field-0 style so a
+// protobuf-net DeserializeWithLengthPrefix<ClientMessage> accepts it.
+void append_reply_frame(uint64_t client_tag, int ok, const uint8_t* resp,
+                        size_t resp_len, std::vector<uint8_t>& out) {
   std::vector<uint8_t> body;
   put_uint(2, client_tag & 0xffffffff, body);
-  if (result && *result) put_str(8, result, body);
-  if (response && *response) put_str(9, response, body);
-  std::vector<uint8_t> frame(body.size() + 12);
-  int fl = janus_frame_encode(body.data(), int(body.size()), 1, frame.data(),
-                              int(frame.size()));
-  if (fl < 0) return -1;
+  put_uint(8, ok ? 1 : 0, body);
+  if (resp_len) {
+    put_varint(uint64_t(9) << 3 | 2, body);
+    put_varint(resp_len, body);
+    body.insert(body.end(), resp, resp + resp_len);
+  }
+  put_varint(body.size(), out);
+  out.insert(out.end(), body.begin(), body.end());
+}
 
-  // The io thread closes fds and erases conns on disconnect under
-  // s->mu, so sending on the raw fd after unlock could hit a closed or
-  // kernel-reused descriptor — but holding the lock across a blocking
-  // send would let one stalled client wedge the whole io loop. dup()
-  // under the lock instead: the duplicate stays valid after the io
-  // thread's close (worst case the send fails with EPIPE).
+// Send one connection's accumulated reply bytes. See the dup() note:
+// the io thread closes fds under s->mu on disconnect, so we dup under
+// the lock and send on the duplicate — a stalled client must not wedge
+// the io loop, and a raced close must not hit a reused descriptor.
+bool send_to_conn(JanusServer* s, uint32_t cid,
+                  const std::vector<uint8_t>& bytes) {
   int fd;
   {
     std::lock_guard<std::mutex> lk(s->mu);
-    auto it = s->conns.find(uint32_t(client_tag >> 32));
-    if (it == s->conns.end()) return -2;
+    auto it = s->conns.find(cid);
+    if (it == s->conns.end()) return false;
     fd = ::dup(it->second.fd);
-    if (fd < 0) return -2;
+    if (fd < 0) return false;
   }
   ssize_t off = 0;
-  while (off < fl) {
-    ssize_t n = ::send(fd, frame.data() + off, size_t(fl - off), MSG_NOSIGNAL);
+  while (off < ssize_t(bytes.size())) {
+    ssize_t n = ::send(fd, bytes.data() + off, bytes.size() - size_t(off),
+                       MSG_NOSIGNAL);
     if (n <= 0) {
       ::close(fd);
-      return -3;
+      return false;
     }
     off += n;
   }
   ::close(fd);
+  return true;
+}
+
+}  // namespace
+
+extern "C" int janus_server_reply(JanusServer* s, uint64_t client_tag, int ok,
+                                  const char* response) {
+  std::vector<uint8_t> bytes;
+  size_t rl = response ? strlen(response) : 0;
+  append_reply_frame(client_tag, ok,
+                     reinterpret_cast<const uint8_t*>(response), rl, bytes);
+  if (!send_to_conn(s, uint32_t(client_tag >> 32), bytes)) return -2;
   s->replies_out.fetch_add(1, std::memory_order_relaxed);
   return 0;
+}
+
+extern "C" int janus_server_reply_batch(JanusServer* s, int n,
+                                        const uint64_t* tags,
+                                        const uint8_t* ok,
+                                        const uint8_t* response_buf,
+                                        const int32_t* response_off) {
+  // group frames per connection IN ORDER (TCP preserves our append
+  // order per connection, so a client's replies arrive in step order)
+  std::unordered_map<uint32_t, std::vector<uint8_t>> per_conn;
+  std::unordered_map<uint32_t, int> counts;
+  for (int i = 0; i < n; i++) {
+    uint32_t cid = uint32_t(tags[i] >> 32);
+    append_reply_frame(tags[i], ok[i], response_buf + response_off[i],
+                       size_t(response_off[i + 1] - response_off[i]),
+                       per_conn[cid]);
+    counts[cid]++;
+  }
+  int sent = 0;
+  for (auto& [cid, bytes] : per_conn)
+    if (send_to_conn(s, cid, bytes)) sent += counts[cid];
+  s->replies_out.fetch_add(sent, std::memory_order_relaxed);
+  return sent;
 }
 
 extern "C" long long janus_server_ops_received(JanusServer* s) {

@@ -74,9 +74,19 @@ def load() -> ctypes.CDLL:
         lib.janus_server_poll_batch.restype = c.c_int
         lib.janus_server_key_count.argtypes = [c.c_void_p, c.c_int]
         lib.janus_server_key_count.restype = c.c_int
-        lib.janus_server_reply.argtypes = [c.c_void_p, c.c_uint64, c.c_char_p,
+        lib.janus_server_key_name.argtypes = [c.c_void_p, c.c_int, c.c_int,
+                                              c.c_char_p, c.c_int]
+        lib.janus_server_key_name.restype = c.c_int
+        lib.janus_server_value_name.argtypes = [c.c_void_p, c.c_int,
+                                                c.c_char_p, c.c_int]
+        lib.janus_server_value_name.restype = c.c_int
+        lib.janus_server_reply.argtypes = [c.c_void_p, c.c_uint64, c.c_int,
                                            c.c_char_p]
         lib.janus_server_reply.restype = c.c_int
+        lib.janus_server_reply_batch.argtypes = [
+            c.c_void_p, c.c_int, u64p, u8p, u8p, i32p,
+        ]
+        lib.janus_server_reply_batch.restype = c.c_int
         for f in ("ops_received", "replies_sent"):
             getattr(lib, f"janus_server_{f}").argtypes = [c.c_void_p]
             getattr(lib, f"janus_server_{f}").restype = c.c_longlong
@@ -194,10 +204,52 @@ class NativeServer:
     def key_count(self, type_id: int) -> int:
         return self._lib.janus_server_key_count(self._h, type_id)
 
+    def key_name(self, type_id: int, slot: int) -> Optional[str]:
+        """Reverse lookup: key slot -> key string (split-cluster mode
+        replicates key identity by NAME, since slot interning order is
+        process-local)."""
+        buf = ctypes.create_string_buffer(4096)
+        n = self._lib.janus_server_key_name(self._h, type_id, slot, buf, 4096)
+        return buf.raw[:n].decode() if n >= 0 else None
+
+    def value_name(self, value_id: int) -> Optional[str]:
+        """Reverse lookup: interned param id -> original string."""
+        buf = ctypes.create_string_buffer(4096)
+        n = self._lib.janus_server_value_name(self._h, value_id, buf, 4096)
+        return buf.raw[:n].decode() if n >= 0 else None
+
     def reply(self, client_tag: int, result: str = "", response: str = "") -> int:
+        """Send one reply. ``result`` is the value/error text (rides the
+        wire as the reference's ClientMessage.response string, field 9);
+        ``response`` is the service-side status tag ("ok"/"su"/"err") —
+        only its err-ness crosses the wire, as the bool result field 8
+        (the reference's reply shape, ClientInterface.cs:304-323)."""
         return self._lib.janus_server_reply(
             self._h, ctypes.c_uint64(client_tag),
-            result.encode(), response.encode(),
+            0 if response == "err" else 1, result.encode(),
+        )
+
+    def reply_batch(self, replies) -> int:
+        """Send many replies with one native call and one TCP send per
+        distinct connection. ``replies`` = [(client_tag, result_text,
+        status)] with status as in ``reply``."""
+        n = len(replies)
+        if n == 0:
+            return 0
+        c = ctypes
+        tags = np.fromiter((t for t, _r, _s in replies), np.uint64, n)
+        ok = np.fromiter((0 if s == "err" else 1 for _t, _r, s in replies),
+                         np.uint8, n)
+        texts = [r.encode() for _t, r, _s in replies]
+        off = np.zeros(n + 1, np.int32)
+        off[1:] = np.cumsum([len(t) for t in texts])
+        buf = np.frombuffer(b"".join(texts) or b"\0", np.uint8)
+        return self._lib.janus_server_reply_batch(
+            self._h, n,
+            tags.ctypes.data_as(c.POINTER(c.c_uint64)),
+            ok.ctypes.data_as(c.POINTER(c.c_uint8)),
+            buf.ctypes.data_as(c.POINTER(c.c_uint8)),
+            off.ctypes.data_as(c.POINTER(c.c_int32)),
         )
 
     def ops_received(self) -> int:

@@ -71,13 +71,25 @@ def encode_client_message(seq: int, key: str, type_code: str, op_code: str,
 
 
 def frame(payload: bytes, field: int = 1) -> bytes:
-    """Base128 length-prefix framing (framing.cc)."""
+    """Tagged Base128 length-prefix framing (framing.cc) — the DAG
+    plane's subtype framing (field number names the message type, the
+    reference's CMNode.cs:81 convention)."""
     return _varint(field << 3 | 2) + _varint(len(payload)) + payload
 
 
+def frame0(payload: bytes) -> bytes:
+    """Field-0 framing: bare varint length, no tag — byte-identical to
+    protobuf-net's 3-arg SerializeWithLengthPrefix(PrefixStyle.Base128),
+    which is what the reference client/server speak on the client plane
+    (ServerConnection.cs:51, ClientInterface.cs:56)."""
+    return _varint(len(payload)) + payload
+
+
 def decode_reply(payload: bytes) -> Dict[str, object]:
-    """Parse a reply frame: {seq, result, response}."""
-    out: Dict[str, object] = {"seq": None, "result": "", "response": ""}
+    """Parse a reply payload (the reference's ClientMessage reply shape,
+    ClientInterface.cs:304-323): {seq, ok (bool, field 8), payload
+    (string, field 9)}."""
+    out: Dict[str, object] = {"seq": None, "ok": True, "payload": ""}
     off = 0
     while off < len(payload):
         tag, off = _read_varint(payload, off)
@@ -88,14 +100,14 @@ def decode_reply(payload: bytes) -> Dict[str, object]:
             v, off = _read_varint(payload, off)
             if field == 2:
                 out["seq"] = v
+            elif field == 8:
+                out["ok"] = bool(v)
         elif wt == 2:
             n, off = _read_varint(payload, off)
             s = payload[off: off + n].decode(errors="replace")
             off += n
-            if field == 8:
-                out["result"] = s
-            elif field == 9:
-                out["response"] = s
+            if field == 9:
+                out["payload"] = s
         else:
             break
     return out
@@ -116,6 +128,10 @@ class JanusClient:
         # to deliver replies (full-duplex stall otherwise)
         self._send_lock = threading.Lock()
         self._replies: Dict[int, Dict[str, object]] = {}
+        # seqs sent as safe updates: their single (deferred) reply is the
+        # post-consensus ack — the wire carries no marker (the reference
+        # client also distinguishes by knowing which seqs were safe)
+        self._safe_seqs: set = set()
         self._cv = threading.Condition(self._lock)
         self._closed = False
         self._rx = threading.Thread(target=self._recv_loop, daemon=True)
@@ -134,22 +150,35 @@ class JanusClient:
                 break
             buf.extend(chunk)
             while True:
-                parsed = self._try_frame(buf)
+                try:
+                    parsed = self._try_frame(buf)
+                except ValueError:
+                    buf.clear()  # malformed frame: drop buffered bytes
+                    break
                 if parsed is None:
                     break
                 with self._cv:
                     if parsed["seq"] is not None:
-                        self._replies[int(parsed["seq"])] = parsed
+                        seq = int(parsed["seq"])
+                        # map to the API shape HERE so a reply that is
+                        # never awaited (fire-and-forget send, timed-out
+                        # wait) still clears its _safe_seqs entry
+                        safe = seq in self._safe_seqs
+                        self._safe_seqs.discard(seq)
+                        status = ("err" if not parsed["ok"]
+                                  else ("su" if safe else "ok"))
+                        self._replies[seq] = {
+                            "seq": seq, "result": parsed["payload"],
+                            "response": status,
+                        }
                         self._cv.notify_all()
 
     @staticmethod
     def _try_frame(buf: bytearray):
         # parse in place (indexing works on bytearray) — copying the
-        # whole buffer per frame would be quadratic under reply backlog
-        tag, off = _read_varint(buf, 0)
-        if tag is None:
-            return None
-        n, off = _read_varint(buf, off)
+        # whole buffer per frame would be quadratic under reply backlog.
+        # Field-0 framing: bare varint length (protobuf-net convention).
+        n, off = _read_varint(buf, 0)
         if n is None or off + n > len(buf):
             return None
         payload = bytes(buf[off: off + n])
@@ -164,14 +193,23 @@ class JanusClient:
         with self._lock:
             self._seq += 1
             seq = self._seq
+            # only UPDATE-class ops take the deferred-ack path; the
+            # service answers creates/reads/stats immediately even when
+            # flagged safe, and labeling those "su" would fake a
+            # consensus ack (service._ingest routes by op code)
+            if is_safe and op_code not in ("s", "gp", "gs", "sp", "ss", "g"):
+                self._safe_seqs.add(seq)
         msg = encode_client_message(seq, key, type_code, op_code, params,
                                     is_safe)
         with self._send_lock:
-            self.sock.sendall(frame(msg))
+            self.sock.sendall(frame0(msg))
         return seq
 
     def wait(self, seq: int, timeout: Optional[float] = None) -> Dict[str, object]:
-        """Block until the reply for ``seq`` arrives."""
+        """Block until the reply for ``seq`` arrives. Returns
+        ``{seq, result, response}`` — ``result`` is the value/error text,
+        ``response`` the status: "su" (deferred safe-update ack), "ok",
+        or "err" (the reference's result=false)."""
         deadline = time.monotonic() + (timeout or self.timeout)
         with self._cv:
             while seq not in self._replies:

@@ -1,0 +1,176 @@
+"""Golden-bytes wire interop with the reference's protobuf-net client.
+
+The reference client serializes ClientMessage with protobuf-net:
+``Serializer.SerializeWithLengthPrefix(stream, msg, PrefixStyle.Base128)``
+(BFT-CRDT-Client/ServerConnection.cs:51) — a BARE varint length prefix
+(fieldNumber=0, so no header tag) followed by a standard protobuf body
+whose field numbers come from the [ProtoMember] attributes
+(BFT-CRDT/Network/ClientMessages.cs:13-34):
+
+    1 sourceType varint   2 sequenceNumber varint   3 key string
+    4 typeCode string     5 opCode string           6 isSafe varint
+    7 params repeated string   8 result varint BOOL  9 response string
+
+The fixtures below are written as literal bytes, hand-derived from that
+schema — NOT built with this repo's encoder — so they prove the native
+parser accepts exactly what a protobuf-net client emits, and that our
+replies parse under the reference's reply shape (result is the bool
+field 8; the value text rides response, field 9 —
+ClientInterface.CreateResponse, ClientInterface.cs:304-323).
+"""
+import socket
+import time
+
+import pytest
+
+from janus_tpu.net.service import JanusConfig, JanusService, TypeConfig
+
+
+def _recv_frames(sock, want, timeout=30.0):
+    """Collect ``want`` bare-varint-length frames from the socket."""
+    buf = bytearray()
+    frames = []
+    deadline = time.monotonic() + timeout
+    sock.settimeout(1.0)
+    while len(frames) < want:
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"got {len(frames)}/{want} frames")
+        try:
+            chunk = sock.recv(65536)
+        except socket.timeout:
+            continue
+        if not chunk:
+            break
+        buf.extend(chunk)
+        while True:
+            # bare varint length
+            n, shift, off = 0, 0, 0
+            complete = False
+            while off < len(buf):
+                b = buf[off]
+                n |= (b & 0x7F) << shift
+                shift += 7
+                off += 1
+                if not (b & 0x80):
+                    complete = True
+                    break
+            if not complete or off + n > len(buf):
+                break
+            frames.append(bytes(buf[off: off + n]))
+            del buf[: off + n]
+    return frames
+
+
+def _parse_reply(payload):
+    """Minimal protobuf walk of a reply body: {seq, result_bool, response}."""
+    out = {"seq": None, "result": None, "response": None}
+    off = 0
+    while off < len(payload):
+        tag = 0
+        shift = 0
+        while True:
+            b = payload[off]
+            off += 1
+            tag |= (b & 0x7F) << shift
+            shift += 7
+            if not (b & 0x80):
+                break
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v = 0
+            shift = 0
+            while True:
+                b = payload[off]
+                off += 1
+                v |= (b & 0x7F) << shift
+                shift += 7
+                if not (b & 0x80):
+                    break
+            if field == 2:
+                out["seq"] = v
+            elif field == 8:
+                out["result"] = bool(v)
+        elif wt == 2:
+            n = 0
+            shift = 0
+            while True:
+                b = payload[off]
+                off += 1
+                n |= (b & 0x7F) << shift
+                shift += 7
+                if not (b & 0x80):
+                    break
+            if field == 9:
+                out["response"] = payload[off: off + n].decode()
+            off += n
+        else:
+            pytest.fail(f"reply used unexpected wire type {wt}")
+    return out
+
+
+# Hand-encoded protobuf-net request frames (sourceType=Client(1)).
+# create: seq=1 key="acct" typeCode="pnc" opCode="s"
+CREATE = bytes([
+    0x12,                                      # bare varint length = 18
+    0x08, 0x01,                                # 1: sourceType = 1
+    0x10, 0x01,                                # 2: seq = 1
+    0x1A, 0x04, 0x61, 0x63, 0x63, 0x74,        # 3: key = "acct"
+    0x22, 0x03, 0x70, 0x6E, 0x63,              # 4: typeCode = "pnc"
+    0x2A, 0x01, 0x73,                          # 5: opCode = "s"
+])
+# increment: seq=2 opCode="i" params=["5"]
+INCR = bytes([
+    0x15,                                      # length = 21
+    0x08, 0x01,
+    0x10, 0x02,                                # 2: seq = 2
+    0x1A, 0x04, 0x61, 0x63, 0x63, 0x74,
+    0x22, 0x03, 0x70, 0x6E, 0x63,
+    0x2A, 0x01, 0x69,                          # 5: opCode = "i"
+    0x3A, 0x01, 0x35,                          # 7: params[0] = "5"
+])
+# prospective read: seq=3 opCode="gp"
+READ = bytes([
+    0x13,                                      # length = 19
+    0x08, 0x01,
+    0x10, 0x03,                                # 2: seq = 3
+    0x1A, 0x04, 0x61, 0x63, 0x63, 0x74,
+    0x22, 0x03, 0x70, 0x6E, 0x63,
+    0x2A, 0x02, 0x67, 0x70,                    # 5: opCode = "gp"
+])
+# update on a never-created key -> error reply: seq=4 key="ghost"
+GHOST = bytes([
+    0x16,                                      # length = 22
+    0x08, 0x01,
+    0x10, 0x04,                                # 2: seq = 4
+    0x1A, 0x05, 0x67, 0x68, 0x6F, 0x73, 0x74,  # 3: key = "ghost"
+    0x22, 0x03, 0x70, 0x6E, 0x63,
+    0x2A, 0x01, 0x69,
+    0x3A, 0x01, 0x35,
+])
+
+
+def test_protobuf_net_golden_bytes():
+    cfg = JanusConfig(num_nodes=4, window=8, ops_per_block=8,
+                      types=(TypeConfig("pnc", {"num_keys": 8}),))
+    with JanusService(cfg) as svc:
+        with socket.create_connection(("127.0.0.1", svc.server.port),
+                                      timeout=30) as sock:
+            sock.sendall(CREATE)
+            frames = _recv_frames(sock, 1)
+            create_rep = _parse_reply(frames[0])
+            assert create_rep["seq"] == 1
+            assert create_rep["result"] is True
+
+            sock.sendall(INCR + READ + GHOST)
+            replies = [_parse_reply(f) for f in _recv_frames(sock, 3)]
+            by_seq = {r["seq"]: r for r in replies}
+            assert set(by_seq) == {2, 3, 4}
+            # unsafe update: result=true (the bool, field 8)
+            assert by_seq[2]["result"] is True
+            # read: the VALUE rides response (field 9), like the
+            # reference's output string
+            assert by_seq[3]["result"] is True
+            assert by_seq[3]["response"] == "5"
+            # unknown key: result=false + error text in response
+            assert by_seq[4]["result"] is False
+            assert "error" in by_seq[4]["response"]
