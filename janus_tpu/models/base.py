@@ -105,6 +105,19 @@ class CRDTTypeSpec:
     # captures every observation. SafeKV refuses specs that are neither
     # (silent divergence otherwise — round-1 advisor finding).
     replay_safe: bool = False
+    # Runtime compaction at GC fences: ``compact_fence(state, live_ops)
+    # -> state`` reclaims dead slots (tombstones) while PROTECTING any
+    # slot whose identity is still referenced by an op in the live
+    # consensus window (``live_ops``: the flattened [T, ...] op-buffer
+    # fields) — a tag/element whose minting op could still replay into a
+    # lagging view must keep its sticky tombstone or the replay would
+    # resurrect it. SafeKV invokes this on every view's prospective and
+    # stable state whenever the DAG's GC frontier advances (the
+    # coordination point: collected blocks can never re-apply anywhere).
+    # The principled replacement for the reference's unbounded tag growth
+    # + the benchmark's 50-element reset hack (paper §6.2 "MessageSize";
+    # ORSetWorkload.cs:50-63).
+    compact_fence: Callable[[Any, OpBatch], Any] | None = None
 
 
 def capture_and_apply(spec: CRDTTypeSpec, state: Any, ops: OpBatch):

@@ -374,14 +374,17 @@ def live_count(state: State) -> jnp.ndarray:
     return jnp.sum(lookup_mask(state), axis=-1)
 
 
-def compact(state: State) -> State:
+def compact(state: State, protect: jnp.ndarray | None = None) -> State:
     """Drop tombstoned slots to reclaim capacity.
 
     Only safe at coordination points where every replica has observed the
     tombstones (e.g. after a consensus commit applies to the stable state)
     — otherwise a lagging replica's merge could resurrect the tag.
-    """
+    ``protect`` ([..., K, C] bool) pins slots that must survive even when
+    tombstoned (the fence's still-referenced guard)."""
     keep = state["valid"] & ~state["removed"]
+    if protect is not None:
+        keep = keep | (state["valid"] & protect)
     rank = (~keep).astype(jnp.int32)
     ops = (
         rank,
@@ -400,6 +403,37 @@ def compact(state: State) -> State:
             "_rm_cap": state["_rm_cap"]}
 
 
+def element_count(state: State) -> jnp.ndarray:
+    """[..., K] occupied slots per key, tombstones INCLUDED — the
+    capacity-pressure signal compaction relieves."""
+    return jnp.sum(state["valid"], axis=-1)
+
+
+def compact_fence(state: State, live_ops: base.OpBatch) -> State:
+    """GC-fence compaction: reclaim tombstoned tags EXCEPT those whose
+    minting add is still in the live consensus window.
+
+    Soundness: a tombstoned tag's add op either (a) still rides a live
+    block — protected here, because a view that has not yet applied that
+    block would resurrect the tag when it replays the add into a
+    compacted (tombstone-free) row — or (b) rode a block the GC frontier
+    already passed, which by the collection rule has been applied by (or
+    state-transfer-fenced into) every view and can never replay. Removes
+    still in flight re-insert their captured tags as already-dead slots,
+    so compacting ahead of them is harmless. Host pending queues cannot
+    reference an unboarded tag: observation requires application, which
+    requires boarding (service mints tags at ingest, but a tombstone only
+    ever captures an OBSERVED tag)."""
+    k, c = state["elem"].shape[-2], state["elem"].shape[-1]
+    from janus_tpu.ops import mark_members
+    prot = mark_members(
+        (state["tag_rep"].reshape(-1), state["tag_ctr"].reshape(-1)),
+        (live_ops["a1"], live_ops["a2"]),
+        (live_ops["op"] == OP_ADD),
+    ).reshape(k, c)
+    return compact(state, protect=prot)
+
+
 SPEC = base.register_type(
     base.CRDTTypeSpec(
         name="ORSet",
@@ -407,12 +441,14 @@ SPEC = base.register_type(
         init=init,
         apply_ops=apply_ops,
         merge=merge,
-        queries={"contains": contains, "live_count": live_count},
+        queries={"contains": contains, "live_count": live_count,
+                 "element_count": element_count},
         # wire opCodes: a=add, r=remove, c=clear (ORSetCommand.cs:13-87)
         op_codes={"a": OP_ADD, "r": OP_REMOVE, "c": OP_CLEAR},
         op_extras={"rm_rep": "rm_capacity", "rm_ctr": "rm_capacity",
                    "rm_elem": "rm_capacity"},
         dim_defaults={"rm_capacity": "capacity"},
         prepare_ops=prepare_ops,
+        compact_fence=compact_fence,
     )
 )

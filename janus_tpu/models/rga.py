@@ -50,6 +50,8 @@ OP_DELETE = 2   # (a1,a2)=(target_rep, target_ctr)
 KEY_FIELDS = ("id_ctr", "id_rep")
 ROOT = (0, 0)   # the virtual head anchor; real ids have ctr >= 1
 State = Dict[str, jnp.ndarray]  # fields [..., K, C] + meta
+# non-slot state fields (excluded from per-slot walks/joins)
+_META = ("_depth", "ctr_floor")
 
 
 def init(num_keys: int, capacity: int, max_depth: int = 32) -> State:
@@ -65,6 +67,11 @@ def init(num_keys: int, capacity: int, max_depth: int = 32) -> State:
     # sort-key count), so it rides in a zero-byte field's SHAPE — robust
     # to the runtime broadcasting state over a leading replica axis
     st["_depth"] = jnp.zeros((max_depth, 0), jnp.int32)
+    # monotone per-doc Lamport floor: the highest counter EVER observed,
+    # surviving compaction — minting from the live slots' max alone
+    # would re-issue a compacted element's counter and collide two
+    # distinct elements on one id (slot_union folds by id)
+    st["ctr_floor"] = jnp.zeros((num_keys,), jnp.int32)
     return st
 
 
@@ -90,6 +97,7 @@ def prepare_ops(state: State, ops: base.OpBatch) -> base.OpBatch:
     rows_valid = state["valid"][ops["key"]]          # [B, C]
     rows_ctr = state["id_ctr"][ops["key"]]
     row_max = jnp.max(jnp.where(rows_valid, rows_ctr, 0), axis=-1)  # [B]
+    row_max = jnp.maximum(row_max, state["ctr_floor"][ops["key"]])
     eff = jnp.where(ops["op"] == OP_INSERT, row_max + 1, 0)
     return {**ops, "eff_ctr": eff[:, None].astype(jnp.int32)}
 
@@ -102,7 +110,7 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
 
     def step(st, op):
         k = op["key"]
-        row = {f: st[f][k] for f in st if f != "_depth"}
+        row = {f: st[f][k] for f in st if f not in _META}
         en = op["op"] != base.OP_NOOP
         is_ins = en & (op["op"] == OP_INSERT)
         is_del = en & (op["op"] == OP_DELETE)
@@ -112,7 +120,9 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
         else:
             # host-direct path: derive the Lamport counter here (NOT
             # replay-safe across replicas — SafeKV always captures)
-            ctr = jnp.max(jnp.where(row["valid"], row["id_ctr"], 0)) + 1
+            ctr = jnp.maximum(
+                jnp.max(jnp.where(row["valid"], row["id_ctr"], 0)),
+                st["ctr_floor"][k]) + 1
 
         inserted = row_upsert(
             row, KEY_FIELDS, (ctr, op["writer"]),
@@ -141,8 +151,15 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
             },
             enabled=is_del,
         )
-        st = {f: (st[f] if f == "_depth" else st[f].at[k].set(deleted[f]))
+        # floor advances with every counter this op carries (insert's
+        # minted ctr; delete's target ctr is an observed one, so folding
+        # it in costs nothing and helps replay order)
+        seen_ctr = jnp.maximum(is_ins * ctr, is_del * op["a2"])
+        new_floor = st["ctr_floor"].at[k].max(
+            jnp.where(en, seen_ctr, 0).astype(jnp.int32))
+        st = {f: (st[f] if f in _META else st[f].at[k].set(deleted[f]))
               for f in st}
+        st["ctr_floor"] = new_floor
         return st, None
 
     state, _ = lax.scan(
@@ -162,10 +179,11 @@ def merge_with_stats(a: State, b: State):
     under gossip can diverge replicas, so capacity must be sized to the
     live population and monitored through this count."""
     cap = a["id_ctr"].shape[-1]
-    sa = {f: v for f, v in a.items() if f != "_depth"}
-    sb = {f: v for f, v in b.items() if f != "_depth"}
+    sa = {f: v for f, v in a.items() if f not in _META}
+    sb = {f: v for f, v in b.items() if f not in _META}
     out, overflow = slot_union(sa, sb, KEY_FIELDS, _combine, capacity=cap)
     out["_depth"] = a["_depth"]
+    out["ctr_floor"] = jnp.maximum(a["ctr_floor"], b["ctr_floor"])
     return out, overflow
 
 
@@ -228,7 +246,7 @@ def text(state: State, key) -> Dict[str, jnp.ndarray]:
     "id_rep"/"id_ctr": [C] element ids in the same order (anchors for
     position-based editing APIs), "overflow": linearizer depth flag}."""
     depth = state["_depth"].shape[-2]
-    row = {f: state[f][key] for f in state if f != "_depth"}
+    row = {f: state[f][key] for f in state if f not in _META}
     order, _, overflow = _order_row(row, depth)
     return {
         "chr": row["chr"][order],
@@ -250,17 +268,21 @@ def element_count(state: State) -> jnp.ndarray:
     return jnp.sum(state["valid"], axis=-1)
 
 
-def compact(state: State) -> State:
+def compact(state: State, protect: jnp.ndarray | None = None) -> State:
     """Reclaim tombstoned LEAF slots (elements no live element anchors
     on). Only safe at coordination points (after a consensus commit
     reaches every replica) — like ORSet.compact. Interior tombstones
-    must stay: they are tree structure for their descendants."""
+    must stay: they are tree structure for their descendants.
+    ``protect`` ([..., K, C] bool) pins slots regardless of tombstoning
+    (the fence's still-referenced guard)."""
     # an element is a parent if any valid element references its id
     ref = ((state["id_ctr"][..., :, None] == state["par_ctr"][..., None, :])
            & (state["id_rep"][..., :, None] == state["par_rep"][..., None, :])
            & state["valid"][..., None, :])
     is_parent = jnp.any(ref, axis=-1)
     keep = state["valid"] & (~state["dead"] | is_parent)
+    if protect is not None:
+        keep = keep | (state["valid"] & protect)
     rank = (~keep).astype(jnp.int32)
     fields = ["id_ctr", "id_rep", "par_ctr", "par_rep", "chr", "dead"]
     ops = ((rank,)
@@ -271,9 +293,36 @@ def compact(state: State) -> State:
     srt = lax.sort(ops, dimension=-1, num_keys=1, is_stable=True)
     out = {f: v for f, v in zip(fields, srt[1:-1])}
     out["valid"] = srt[-1]
-    out["dead"] = out["dead"] & out["valid"]
+    # the where() fill promoted dead to int32 — restore bool, or every
+    # downstream `valid & ~dead` silently becomes integer bit-math and
+    # boolean-mask indexing turns into a repeated-index gather
+    out["dead"] = out["dead"].astype(bool) & out["valid"]
     out["_depth"] = state["_depth"]
+    out["ctr_floor"] = state["ctr_floor"]  # the Lamport floor survives
     return out
+
+
+def compact_fence(state: State, live_ops: base.OpBatch) -> State:
+    """GC-fence compaction: reclaim dead leaves EXCEPT elements still
+    referenced by the live consensus window — a live insert's own id
+    (its replay into a lagging view must find the sticky tombstone, not
+    resurrect) and its PARENT id (a view that compacts an anchor before
+    replaying a child would linearize the child at the root while other
+    views nest it — divergence). Deletes need no protection: replaying a
+    delete of a compacted element lands an invisible dead placeholder.
+    See orset.compact_fence for why GC-collected blocks can never bring
+    these references back."""
+    k, c = state["id_ctr"].shape[-2], state["id_ctr"].shape[-1]
+    from janus_tpu.ops import mark_members
+    is_ins = live_ops["op"] == OP_INSERT
+    q_rep = jnp.concatenate([live_ops["writer"], live_ops["a1"]])
+    q_ctr = jnp.concatenate([live_ops["eff_ctr"][..., 0], live_ops["a2"]])
+    prot = mark_members(
+        (state["id_rep"].reshape(-1), state["id_ctr"].reshape(-1)),
+        (q_rep, q_ctr),
+        jnp.concatenate([is_ins, is_ins]),
+    ).reshape(k, c)
+    return compact(state, protect=prot)
 
 
 SPEC = base.register_type(
@@ -289,5 +338,6 @@ SPEC = base.register_type(
         op_codes={"a": OP_INSERT, "r": OP_DELETE},
         op_extras={"eff_ctr": 1},
         prepare_ops=prepare_ops,
+        compact_fence=compact_fence,
     )
 )

@@ -17,6 +17,7 @@ from janus_tpu.ops.lattice import (
     ts_max,
 )
 from janus_tpu.ops.setops import (
+    mark_members,
     slot_union,
     row_find,
     row_first_free,
@@ -35,6 +36,7 @@ __all__ = [
     "ts_after",
     "ts_max",
     "slot_union",
+    "mark_members",
     "row_find",
     "row_first_free",
     "row_upsert",

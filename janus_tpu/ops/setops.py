@@ -213,3 +213,48 @@ def row_upsert(
             en & found, updated[f], jnp.where(en, inserted[f], row[f])
         )
     return out
+
+
+def mark_members(
+    a_keys: Sequence[jnp.ndarray],
+    b_keys: Sequence[jnp.ndarray],
+    b_valid: jnp.ndarray,
+) -> jnp.ndarray:
+    """bool[M]: does A record i's 2-part key equal some valid B key?
+
+    One sort-merge over M+T records instead of an O(M*T) compare matrix —
+    the membership primitive compaction fences use to protect slots whose
+    tag/id is still referenced by a live consensus op. Keys are int32
+    pairs < SENTINEL (A records keyed SENTINEL — invalid slots — only
+    match B SENTINELs, which ``b_valid`` masks out)."""
+    k1a, k2a = a_keys
+    k1b, k2b = b_keys
+    m, t = k1a.shape[0], k1b.shape[0]
+    total = m + t
+    k1 = jnp.concatenate([k1a, jnp.where(b_valid, k1b, SENTINEL)])
+    k2 = jnp.concatenate([k2a, jnp.where(b_valid, k2b, SENTINEL)])
+    is_b = jnp.concatenate([jnp.zeros((m,), bool), b_valid])
+    orig = jnp.concatenate([
+        jnp.arange(m, dtype=jnp.int32), jnp.full((t,), m, jnp.int32)
+    ])
+    # LSD argsort via two stable single-key passes (cheapest multi-key
+    # sort shape on TPU; see orset._apply_captured_batch)
+    idx = jnp.arange(total, dtype=jnp.int32)
+    _, idx = lax.sort((k2, idx), dimension=-1, num_keys=1, is_stable=True)
+    _, idx = lax.sort((k1[idx], idx), dimension=-1, num_keys=1,
+                      is_stable=True)
+    k1s, k2s = k1[idx], k2[idx]
+    is_bs, origs = is_b[idx], orig[idx]
+    first = jnp.ones((total,), bool).at[1:].set(
+        (k1s[1:] != k1s[:-1]) | (k2s[1:] != k2s[:-1]))
+    # segment-OR of is_b via cumsum differences at segment bounds
+    ii = jnp.arange(total, dtype=jnp.int32)
+    bi = is_bs.astype(jnp.int32)
+    csum = jnp.cumsum(bi)
+    nxt_first = lax.cummin(jnp.where(first, ii, total), reverse=True)
+    seg_end = jnp.concatenate(
+        [nxt_first[1:], jnp.asarray([total], jnp.int32)]) - 1
+    seg_start = lax.cummax(jnp.where(first, ii, 0))
+    excl_at_start = (csum - bi)[seg_start]
+    seg_has_b = (csum[jnp.clip(seg_end, 0, total - 1)] - excl_at_start) > 0
+    return jnp.zeros((m + 1,), bool).at[origs].max(seg_has_b)[:m]

@@ -163,17 +163,29 @@ class SafeKV:
         self.stats: Dict[str, int] = {
             "ticks": 0, "blocks_submitted": 0, "own_commits": 0,
             "slots_recycled": 0, "gc_advances": 0, "state_transfers": 0,
+            "compactions": 0,
         }
         self._jit_submit = jax.jit(self._submit_device)
         self._jit_tick = jax.jit(self._tick_device)
         self._jit_step = jax.jit(self._step_device)
+        self._jit_compact = (jax.jit(self._compact_device)
+                             if spec.compact_fence is not None else None)
+        self._jit_step_k = None  # built on first step_k_dispatch
         # in-order absorb cursor for the split dispatch/absorb step path
         self._absorb_tick = 0
 
     # -- device programs ---------------------------------------------------
 
+    # Split-cluster seam: a subclass owning a subset of the emulated
+    # nodes narrows submission to them (mirror views' content arrives
+    # over the wire; locally "accepting" a mirror's batch would mark its
+    # origin fast-path as applied without the real remote ops, silently
+    # corrupting the mirror's prospective state).
+    _submit_mask = None
+
     def _submit_device(self, prospective, dag_state, ops_buffer, buffer_filled,
-                       prosp_applied, ops: base.OpBatch):
+                       prosp_applied, ops: base.OpBatch,
+                       active: Optional[jnp.ndarray] = None):
         cfg = self.cfg
         n = cfg.num_nodes
         vs = jnp.arange(n)
@@ -189,6 +201,10 @@ class SafeKV:
                     & (r >= dag_state["base_round"])  # straggler below the
                     # frontier: its slot belongs to round r+W now
                     & (r < dag_state["base_round"] + cfg.num_rounds))  # [N]
+        if active is not None:
+            accepted = accepted & active  # crashed nodes accept no ops
+        if self._submit_mask is not None:
+            accepted = accepted & self._submit_mask
         acc_ops = {
             f: jnp.where(accepted[:, None], ops[f], base.OP_NOOP if f == "op" else 0)
             for f in base.OP_FIELDS
@@ -211,6 +227,13 @@ class SafeKV:
         new_filled = buffer_filled.at[s, vs].max(accepted)
         new_applied = prosp_applied.at[vs, s, vs].max(accepted)
         return new_prosp, new_buffer, new_filled, new_applied, accepted
+
+    def _round_step(self, dag_state, active, withhold, invalid):
+        """One DAG protocol round — overridable seam: the in-emulation
+        default runs every phase for every node; a split-cluster node
+        runs masked phases for its owned nodes only (net/splitnode.py)."""
+        return dagmod.round_step(self.cfg, dag_state, active, withhold,
+                                 invalid)
 
     def _causal_closure(self, dag_state, applied):
         """Blocks applicable in each view: certificate held, not yet
@@ -330,8 +353,7 @@ class SafeKV:
             prospective, stable, dag_state, cstate, prosp_applied,
             stable_applied, force)
 
-        dag_state = dagmod.round_step(cfg, dag_state, active, withhold,
-                                      invalid)
+        dag_state = self._round_step(dag_state, active, withhold, invalid)
 
         # -- prospective: delta-apply newly certified, causally-ready blocks
         prosp_ready = self._causal_closure(dag_state, prosp_applied)
@@ -472,7 +494,7 @@ class SafeKV:
         (prospective, ops_buffer, buffer_filled, prosp_applied,
          accepted) = self._submit_device(
             prospective, dag_state, ops_buffer, buffer_filled,
-            prosp_applied, ops)
+            prosp_applied, ops, active)
         (prospective, stable, dag_state, cstate, ops_buffer, buffer_filled,
          prosp_applied, stable_applied, fresh_com, _seq_snap, recycled,
          _transferred, _donor, lost) = self._tick_device(
@@ -498,6 +520,92 @@ class SafeKV:
         packed = jnp.concatenate(parts)
         return (prospective, stable, dag_state, cstate, ops_buffer,
                 buffer_filled, prosp_applied, stable_applied, lost, packed)
+
+    def _step_k_device(self, prospective, stable, dag_state, cstate,
+                       ops_buffer, buffer_filled, prosp_applied,
+                       stable_applied, force, ops_k,
+                       active, withhold, invalid):
+        """K fused protocol rounds in ONE dispatch (lax.scan over the
+        fused step): on a remote/tunneled backend the per-round
+        dispatch+fetch costs a network round trip, so K rounds per
+        dispatch divide the op->commit observation floor by K — a block
+        boarded in round j of a dispatch COMMITS inside the same
+        dispatch when j + commit-lag < K, making the measured latency
+        one fetch rather than commit-lag fetches. ``ops_k`` stacks K op
+        batches [K, N, B]."""
+
+        def body(carry, ops):
+            out = self._step_device(*carry, ops, active, withhold, invalid)
+            return out[:9], out[9]
+
+        carry0 = (prospective, stable, dag_state, cstate, ops_buffer,
+                  buffer_filled, prosp_applied, stable_applied, force)
+        carry, packed_k = jax.lax.scan(body, carry0, ops_k)
+        return carry + (packed_k,)
+
+    def step_k_dispatch(self, ops_k, safe_k=None, active=None, withhold=None,
+                        record=True, invalid=None):
+        """Dispatch K fused rounds; returns (packed_k, metas). Pass both
+        to ``step_k_absorb`` in dispatch order. ``ops_k``: [K, N, B] per
+        field; ``safe_k``: optional [K, N, B] bools."""
+        if self._jit_step_k is None:
+            self._jit_step_k = jax.jit(self._step_k_device)
+        k = int(next(iter(ops_k.values())).shape[0])
+        (self.prospective, self.stable, self.dag, self.commit,
+         self.ops_buffer, self.buffer_filled, self.prosp_applied,
+         self.stable_applied, self.force_transfer, packed_k) = \
+            self._jit_step_k(
+                self.prospective, self.stable, self.dag, self.commit,
+                self.ops_buffer, self.buffer_filled, self.prosp_applied,
+                self.stable_applied, self.force_transfer, ops_k,
+                active, withhold, invalid)
+        n = self.cfg.num_nodes
+        if record is True:
+            rec_mask = np.ones((n,), bool)
+        elif record is False:
+            rec_mask = np.zeros((n,), bool)
+        else:
+            rec_mask = np.asarray(record, bool)
+        now = time.perf_counter()
+        metas = []
+        for j in range(k):
+            safe = None if safe_k is None else np.asarray(safe_k[j], bool)
+            metas.append((now, self.tick_count, safe, rec_mask))
+            self.tick_count += 1
+        return packed_k, metas
+
+    def step_k_absorb(self, packed_k, metas,
+                      observed_at: float | None = None) -> list:
+        """Absorb K fused rounds' packed outputs (one fetch)."""
+        rows = np.asarray(packed_k)
+        return [self.step_absorb(rows[j], meta, observed_at=observed_at)
+                for j, meta in enumerate(metas)]
+
+    def _compact_device(self, prospective, stable, ops_buffer):
+        """Run the type's GC-fence compaction on every view's prospective
+        AND stable state, guarded by the ops still in the live window
+        (spec.compact_fence's still-referenced protection)."""
+        cfg = self.cfg
+        w, n = cfg.num_rounds, cfg.num_nodes
+        flat = {
+            f: v.reshape((w * n * self.B,) + v.shape[3:])
+            for f, v in ops_buffer.items()
+        }
+        fence = jax.vmap(lambda st: self.spec.compact_fence(st, flat))
+        return fence(prospective), fence(stable)
+
+    def maybe_compact(self) -> bool:
+        """Compact at a GC fence (call when a tick recycled slots; a
+        no-op for types without a compact_fence). The runtime trigger the
+        reference never had — its OR-Set state grows until messages hit
+        196 MB (paper §6.2) and its benchmark resets sets every 50 adds
+        (ORSetWorkload.cs:50-63)."""
+        if self._jit_compact is None:
+            return False
+        self.prospective, self.stable = self._jit_compact(
+            self.prospective, self.stable, self.ops_buffer)
+        self.stats["compactions"] += 1
+        return True
 
     # -- host API ----------------------------------------------------------
 
@@ -537,6 +645,9 @@ class SafeKV:
                 # exactly W to a slot's round, so mirror it incrementally
                 # (tick() refreshes from the device instead)
                 self._host_slot_round[rec] += self.cfg.num_rounds
+            # a GC advance is the coordination point where tombstones
+            # whose ops left the window can be reclaimed
+            self.maybe_compact()
         return newly
 
     def submit(self, ops: base.OpBatch, safe: Optional[np.ndarray] = None) -> np.ndarray:

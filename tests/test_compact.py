@@ -1,0 +1,196 @@
+"""Runtime compaction at GC fences: long-running OR-Set/RGA services
+must reclaim tombstone capacity instead of filling up and dropping
+slots — the principled replacement for the reference's unbounded tag
+growth (196 MB messages, paper §6.2 "MessageSize") and its benchmark's
+50-element reset hack (ORSetWorkload.cs:50-63).
+
+The workload here deliberately exceeds per-key capacity in CUMULATIVE
+tags (every add mints a fresh tag; every tag is eventually tombstoned):
+without the fence the slots exhaust and behavior degrades; with it the
+occupancy stays bounded, convergence holds bit-exactly, and membership
+stays correct throughout.
+"""
+import dataclasses
+
+import numpy as np
+
+from janus_tpu.consensus import DagConfig
+from janus_tpu.models import base, orset, rga
+from janus_tpu.runtime.safecrdt import SafeKV
+
+N, W, B = 4, 8, 2
+K = 2
+CAP = 16
+
+
+def _orset_kv(spec=orset.SPEC):
+    return SafeKV(DagConfig(N, W), spec, ops_per_block=B,
+                  num_keys=K, capacity=CAP, rm_capacity=4)
+
+
+def _churn(kv, cycles, tag_ctr_start=0):
+    """Per cycle: every node adds a fresh-tagged element then removes
+    it — cumulative tags far exceed CAP while live content stays tiny."""
+    ctr = tag_ctr_start
+    vs = np.arange(N, dtype=np.int32)
+    for t in range(cycles):
+        elem = np.full((N, B), 7 + (t % 3), np.int32)
+        add = base.make_op_batch(
+            op=np.full((N, B), orset.OP_ADD, np.int32),
+            key=np.full((N, B), t % K, np.int32),
+            a0=elem,
+            a1=np.broadcast_to(vs[:, None], (N, B)).copy(),
+            a2=np.arange(ctr, ctr + N * B, dtype=np.int32).reshape(N, B),
+            writer=np.broadcast_to(vs[:, None], (N, B)).copy(),
+        )
+        ctr += N * B
+        kv.submit(add)
+        kv.tick()
+        rm = base.make_op_batch(
+            op=np.full((N, B), orset.OP_REMOVE, np.int32),
+            key=np.full((N, B), t % K, np.int32),
+            a0=elem,
+            writer=np.broadcast_to(vs[:, None], (N, B)).copy(),
+        )
+        kv.submit(rm)
+        kv.tick()
+    for _ in range(2 * W):
+        kv.tick()  # settle: every add is now observed everywhere
+    # cleanup: remove every element value once more — observed-remove
+    # semantics mean a cycle's remove missed same-cycle adds from other
+    # nodes that had not yet certified at capture time
+    vs = np.arange(N, dtype=np.int32)
+    for e in (7, 8, 9):
+        for k in range(K):
+            rm = base.make_op_batch(
+                op=np.full((N, B), orset.OP_REMOVE, np.int32),
+                key=np.full((N, B), k, np.int32),
+                a0=np.full((N, B), e, np.int32),
+                writer=np.broadcast_to(vs[:, None], (N, B)).copy(),
+            )
+            kv.submit(rm)
+            kv.tick()
+    for _ in range(2 * W):
+        kv.tick()  # drain: commit + apply everything
+    return ctr
+
+
+def test_orset_overflows_without_fence():
+    """Control: the same churn with compaction disabled fills every slot
+    (proving the main test's workload would overflow)."""
+    spec = dataclasses.replace(orset.SPEC, compact_fence=None)
+    kv = _orset_kv(spec)
+    _churn(kv, 3 * CAP)
+    occ = np.asarray(kv.query_prospective("element_count"))  # [N, K]
+    assert int(occ.max()) == CAP, f"expected full rows, got {occ.max()}"
+    assert kv.stats["compactions"] == 0
+
+
+def test_orset_long_run_with_compaction():
+    kv = _orset_kv()
+    cycles = 3 * CAP  # 3x capacity in cumulative tags per key
+    _churn(kv, cycles)
+    assert kv.stats["compactions"] > 0, "GC fences never compacted"
+    occ = np.asarray(kv.query_prospective("element_count"))
+    assert int(occ.max()) < CAP, f"occupancy {occ.max()} not reclaimed"
+    # membership stayed correct: every element was removed (cleanup
+    # pass) after all its adds were observed
+    for k in range(K):
+        for e in (7, 8, 9):
+            got = np.asarray(kv.query_prospective("contains", k, e))
+            assert not got.any(), (k, e)
+    # convergence is still bit-exact across views after the drain
+    for f, v in kv.prospective.items():
+        arr = np.asarray(v)
+        for view in range(1, N):
+            np.testing.assert_array_equal(arr[view], arr[0], err_msg=f)
+    for f, v in kv.stable.items():
+        arr = np.asarray(v)
+        np.testing.assert_array_equal(arr, np.asarray(kv.prospective[f]),
+                                      err_msg=f)
+
+
+def test_orset_add_survives_compaction():
+    """A RE-ADDED element (fresh tag after its old tags were compacted)
+    stays present — compaction must never eat live tags."""
+    kv = _orset_kv()
+    ctr = _churn(kv, 2 * CAP)
+    vs = np.arange(N, dtype=np.int32)
+    add = base.make_op_batch(
+        op=np.full((N, B), orset.OP_ADD, np.int32),
+        key=np.zeros((N, B), np.int32),
+        a0=np.full((N, B), 7, np.int32),
+        a1=np.broadcast_to(vs[:, None], (N, B)).copy(),
+        a2=np.arange(ctr, ctr + N * B, dtype=np.int32).reshape(N, B),
+        writer=np.broadcast_to(vs[:, None], (N, B)).copy(),
+    )
+    kv.submit(add)
+    for _ in range(2 * W):
+        kv.tick()
+    got = np.asarray(kv.query_prospective("contains", 0, 7))
+    assert got.all()
+
+
+def test_rga_churn_with_compaction():
+    """Insert+delete churn past capacity: with the fence the document
+    stays editable, ids never collide (the ctr_floor), and views
+    converge on the same text."""
+    kv = SafeKV(DagConfig(N, W), rga.SPEC, ops_per_block=B,
+                num_keys=1, capacity=CAP, max_depth=8)
+    vs = np.arange(N, dtype=np.int32)
+    for t in range(3 * CAP):
+        # one insert per tick (node 0 only): live content stays tiny
+        # while cumulative elements (all eventually tombstoned) pass 3x
+        # capacity — the reclaimable-tombstone regime
+        op = np.zeros((N, B), np.int32)
+        op[0, 0] = rga.OP_INSERT
+        ins = base.make_op_batch(
+            op=op,
+            key=np.zeros((N, B), np.int32),
+            a0=np.full((N, B), 65 + (t % 26), np.int32),
+            writer=np.broadcast_to(vs[:, None], (N, B)).copy(),
+        )
+        kv.submit(ins)
+        kv.tick()
+        # delete every currently-visible element (anchored by id)
+        out = kv.query_prospective("text", 0)
+        live = np.asarray(out["live"])[0]
+        reps = np.asarray(out["id_rep"])[0][live][:B]
+        ctrs = np.asarray(out["id_ctr"])[0][live][:B]
+        m = len(reps)
+        if m:
+            pad = ((0, 0), (0, B - m))
+            dele = base.make_op_batch(
+                op=np.pad(np.full((N, m), rga.OP_DELETE, np.int32), pad),
+                key=np.zeros((N, B), np.int32),
+                a1=np.pad(np.broadcast_to(reps[None, :], (N, m)), pad)
+                    .astype(np.int32),
+                a2=np.pad(np.broadcast_to(ctrs[None, :], (N, m)), pad)
+                    .astype(np.int32),
+                writer=np.broadcast_to(vs[:, None], (N, B)).copy(),
+            )
+            # only node 0 issues deletes (one deleter suffices; every
+            # node deleting the same ids is also legal but noisier)
+            dele = {f: np.where(np.arange(N)[:, None] == 0, v, 0)
+                    for f, v in dele.items()}
+            kv.submit(base.make_op_batch(**dele))
+        kv.tick()
+    for _ in range(2 * W):
+        kv.tick()
+    assert kv.stats["compactions"] > 0
+    occ = np.asarray(kv.query_prospective("element_count"))
+    assert int(occ.max()) < CAP, f"rga occupancy {occ.max()} not reclaimed"
+    # dtype discipline: compaction must not launder bool fields into
+    # int32 (an int 'live' silently turns boolean-mask reads into
+    # integer gathers — the round-4 service text-duplication bug)
+    import numpy as _np
+    assert kv.prospective["dead"].dtype == _np.bool_
+    assert kv.query_prospective("text", 0)["live"].dtype == _np.bool_
+    # all views agree on the final document
+    texts = []
+    out = kv.query_prospective("text", 0)
+    for v in range(N):
+        live = np.asarray(out["live"])[v]
+        chars = np.asarray(out["chr"])[v][live]
+        texts.append("".join(chr(int(c)) for c in chars))
+    assert all(t == texts[0] for t in texts), texts
