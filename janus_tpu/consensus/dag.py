@@ -273,6 +273,12 @@ def ingest_batch(cfg: DagConfig, state: State, seen_by,
             jnp.asarray(rows) & fresh[:, None])
         out["block_seen"] = out["block_seen"].at[
             sb[:, None], ss[None, :], srcs[None, :]].max(ok[None, :])
+        # a block at round r proves its creator reached round r — the
+        # Committee.atRounds learning (Committee.cs:11-57) that lets a
+        # split-cluster GC frontier respect real remote progress instead
+        # of freezing on a mirror's stale view (applied even for
+        # out-of-window copies: the evidence is about the CREATOR)
+        out["node_round"] = out["node_round"].at[srcs].max(jnp.asarray(rs))
     if len(sigs):
         rs = _np.asarray([g[0] for g in sigs], _np.int32)
         srcs = _np.asarray([g[1] for g in sigs], _np.int32)

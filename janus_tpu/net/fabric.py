@@ -1,0 +1,220 @@
+"""Full-mesh TCP fabric for a split-cluster service.
+
+Reference: ConfigParser.GetCluster builds a cluster of CMNodes from a
+JSON topology and Cluster.ConnectAll dials every peer with 5 retries
+(ConfigParser.cs:107-124, Cluster.cs:38-59); ManagerServer accepts the
+inbound side (ManagerServer.cs:43-84). Here each process pair shares ONE
+bidirectional connection: process i accepts from every j > i and dials
+every j < i (a deterministic full mesh without duplicate pipes), with a
+hello frame identifying the dialer.
+
+The fabric multiplexes, over that one pipe per peer:
+- MSG_TYPED (8): one replicated type's DAG-plane bytes (blocks with op
+  payloads, signatures, certificates — net/splitnode.py), prefixed by
+  the type index so each type's SplitNode ingests its own stream.
+- MSG_CREATE (9): key-space create bindings — (type index, key name,
+  round, source node). The reference replicates its key space as a
+  TPSet riding the DAG (KeySpaceManager.cs:55-113); here the binding
+  (key -> block) travels next to the block itself and every process
+  materializes slots by walking its own committed order. The binding
+  frame leaves with the block's send batch, two protocol round-trips
+  before any view can commit the block, so it is always registered
+  before materialization walks past it.
+- MSG_HELLO (10): dialer's process index (connection identity).
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from janus_tpu.net.client import _read_varint, _varint, frame
+from janus_tpu.net.dagplane import TcpPeer
+
+MSG_TYPED = 8
+MSG_CREATE = 9
+MSG_HELLO = 10
+
+
+class DagFabric:
+    """One process's connections to every peer process.
+
+    ``on_type_frame(type_idx, data)`` receives a peer's DAG bytes for
+    one type; ``on_create(type_idx, key, round, src)`` a key-create
+    binding. Both run on receive threads — route into thread-safe
+    queues and drain from the service step."""
+
+    CONNECT_RETRIES = 30
+    RETRY_DELAY = 0.5  # reference: 5 retries x 1s (Cluster.cs:38-59)
+
+    def __init__(self, addresses: List[tuple], proc_index: int,
+                 on_type_frame: Callable[[int, bytes], None],
+                 on_create: Callable[[int, str, int, int], None]):
+        self.addresses = addresses  # [(host, port)] per process
+        self.index = proc_index
+        self.on_type_frame = on_type_frame
+        self.on_create = on_create
+        self.peers: Dict[int, TcpPeer] = {}
+        self._bufs: Dict[int, bytearray] = {}
+        self._lock = threading.Lock()
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- wiring ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Listen, accept from higher-index peers, dial lower-index
+        peers with retries; returns once the mesh is complete."""
+        host, port = self.addresses[self.index]
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(len(self.addresses))
+        self._listener = srv
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+        for j, (h, p) in enumerate(self.addresses):
+            if j >= self.index:
+                continue
+            last = None
+            for _ in range(self.CONNECT_RETRIES):
+                try:
+                    sock = socket.create_connection((h, p), timeout=10)
+                    break
+                except OSError as e:
+                    last = e
+                    time.sleep(self.RETRY_DELAY)
+            else:
+                raise ConnectionError(f"peer {j} at {h}:{p}: {last}")
+            peer = TcpPeer(sock, self._receiver(j))
+            peer.send(frame(_varint(self.index), MSG_HELLO))
+            with self._lock:
+                self.peers[j] = peer
+
+        deadline = time.monotonic() + self.CONNECT_RETRIES * self.RETRY_DELAY
+        want = len(self.addresses) - 1
+        while True:
+            with self._lock:
+                if len(self.peers) >= want:
+                    return
+            if time.monotonic() > deadline:
+                raise ConnectionError(
+                    f"mesh incomplete: {len(self.peers)}/{want} peers")
+            time.sleep(0.05)
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            # the dialer identifies itself with a hello frame; park the
+            # socket in a temporary peer whose receiver promotes it
+            holder = {}
+
+            def on_first(data: bytes, holder=holder, sock=sock):
+                buf = holder.setdefault("buf", bytearray())
+                buf.extend(data)
+                if "idx" not in holder:
+                    tag, off = _read_varint(buf, 0)
+                    if tag is None:
+                        return
+                    n, off = _read_varint(buf, off)
+                    if n is None or off + n > len(buf):
+                        return
+                    if tag >> 3 != MSG_HELLO:
+                        # junk dialer (wrong port/protocol): close it —
+                        # keeping the socket would buffer its bytes
+                        # without bound and leak the receiver thread
+                        holder["idx"] = -1
+                        buf.clear()
+                        holder["peer"].close()
+                        return
+                    idx, _ = _read_varint(bytes(buf[off: off + n]), 0)
+                    del buf[: off + n]
+                    holder["idx"] = int(idx)
+                    with self._lock:
+                        self.peers[holder["idx"]] = holder["peer"]
+                idx = holder["idx"]
+                if idx >= 0 and buf:
+                    data, holder["buf"] = bytes(buf), bytearray()
+                    self._on_bytes(idx, data)
+
+            holder["peer"] = TcpPeer(sock, on_first)
+
+    def _receiver(self, idx: int):
+        return lambda data: self._on_bytes(idx, data)
+
+    # -- demux -----------------------------------------------------------
+
+    def _on_bytes(self, idx: int, data: bytes) -> None:
+        buf = self._bufs.setdefault(idx, bytearray())
+        buf.extend(data)
+        while True:
+            try:
+                tag, off = _read_varint(buf, 0)
+                if tag is None:
+                    break
+                n, off = _read_varint(buf, off)
+            except ValueError:
+                buf.clear()  # unterminated varint: drop the corrupt
+                break        # buffer instead of killing the recv thread
+            if n is None or off + n > len(buf):
+                break
+            payload = bytes(buf[off: off + n])
+            del buf[: off + n]
+            mtype = tag >> 3
+            if mtype == MSG_TYPED:
+                tidx, p = _read_varint(payload, 0)
+                if tidx is not None:
+                    self.on_type_frame(int(tidx), payload[p:])
+            elif mtype == MSG_CREATE:
+                tidx, p = _read_varint(payload, 0)
+                rnd, p = _read_varint(payload, p)
+                src, p = _read_varint(payload, p)
+                klen, p = _read_varint(payload, p)
+                if klen is None or p + klen > len(payload):
+                    continue
+                key = payload[p: p + klen].decode(errors="replace")
+                self.on_create(int(tidx), key, int(rnd), int(src))
+            # MSG_HELLO after promotion: ignore
+
+    # -- outbound --------------------------------------------------------
+
+    def broadcast(self, data: bytes) -> None:
+        with self._lock:
+            peers = list(self.peers.values())
+        for p in peers:
+            try:
+                p.send(data)
+            except OSError:
+                pass  # dead peer: quorum machinery tolerates its absence
+
+    def type_sender(self, type_idx: int):
+        """A SplitNode ``send`` callback wrapping frames for one type."""
+        def send(data: bytes) -> None:
+            self.broadcast(frame(_varint(type_idx) + data, MSG_TYPED))
+        return send
+
+    def send_create(self, type_idx: int, key: str, round_: int,
+                    src: int) -> None:
+        kb = key.encode()
+        body = (_varint(type_idx) + _varint(round_) + _varint(src)
+                + _varint(len(kb)) + kb)
+        self.broadcast(frame(body, MSG_CREATE))
+
+    def close(self) -> None:
+        self._closed = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            for p in self.peers.values():
+                p.close()
+            self.peers.clear()

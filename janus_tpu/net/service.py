@@ -57,9 +57,25 @@ class TypeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ProcConfig:
+    """One process of a split cluster: where its DAG plane listens and
+    which emulated nodes it owns (the cluster-JSON row,
+    ConfigParser.cs:28-124 {nodeid, address, port, isSelf})."""
+
+    address: str
+    dag_port: int
+    owned: Tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class JanusConfig:
     """Runtime tunables (the ConfigParser + DAGOptions + clientBatchSize
-    analog, ConfigParser.cs:28-124, DAG.cs:25-32, JanusService.cs:28-29)."""
+    analog, ConfigParser.cs:28-124, DAG.cs:25-32, JanusService.cs:28-29).
+
+    With ``procs`` set, this service is ONE PROCESS of a split cluster:
+    it owns ``procs[proc_index].owned`` emulated nodes, serves clients
+    for them, and exchanges signed payload-carrying DAG messages with
+    the other processes (net/splitnode.py, net/fabric.py)."""
 
     num_nodes: int = 4
     window: int = 8
@@ -71,14 +87,31 @@ class JanusConfig:
         TypeConfig("pnc", {"num_keys": 64}),
         TypeConfig("orset", {"num_keys": 64, "capacity": 64}),
     )
+    procs: Tuple[ProcConfig, ...] = ()
+    proc_index: int = 0
+
+    @property
+    def split(self) -> bool:
+        return bool(self.procs)
+
+    @property
+    def owned(self) -> Tuple[int, ...]:
+        if not self.procs:
+            return tuple(range(self.num_nodes))
+        return tuple(self.procs[self.proc_index].owned)
 
     @classmethod
-    def from_json(cls, text: str) -> "JanusConfig":
+    def from_json(cls, text: str, proc_index: int = 0) -> "JanusConfig":
         raw = json.loads(text)
         types = tuple(
             TypeConfig(t["type_code"], {k: int(v) for k, v in t["dims"].items()})
             for t in raw.get("types", [])
         ) or cls.types
+        procs = tuple(
+            ProcConfig(p.get("address", "127.0.0.1"), int(p["dag_port"]),
+                       tuple(int(v) for v in p["owned"]))
+            for p in raw.get("procs", [])
+        )
         return cls(
             num_nodes=int(raw.get("num_nodes", 4)),
             window=int(raw.get("window", 8)),
@@ -87,13 +120,17 @@ class JanusConfig:
             port=int(raw.get("port", 0)),
             max_clients=int(raw.get("max_clients", 64)),
             types=types,
+            procs=procs,
+            proc_index=int(raw.get("proc_index", proc_index)),
         )
 
 
 class _TypeRuntime:
-    """One replicated type: its emulated SafeKV cluster + dispatch state."""
+    """One replicated type: its emulated SafeKV cluster + dispatch state.
+    In split mode the cluster is a SplitNode (owned nodes + signed wire,
+    net/splitnode.py) whose SafeKV this runtime reads through."""
 
-    def __init__(self, cfg: JanusConfig, tcfg: TypeConfig):
+    def __init__(self, cfg: JanusConfig, tcfg: TypeConfig, send=None):
         spec = base.get_type(tcfg.type_code)
         dims = dict(tcfg.dims)
         if tcfg.type_code in ("pnc", "mvr"):
@@ -103,12 +140,26 @@ class _TypeRuntime:
             # linearizer bound to match so common typing never overflows
             dims.setdefault("max_depth", int(dims["capacity"]))
         self.spec = spec
-        self.kv = SafeKV(DagConfig(cfg.num_nodes, cfg.window), spec,
-                         ops_per_block=cfg.ops_per_block, **dims)
+        self.node = None
+        if cfg.split:
+            from janus_tpu.net.splitnode import SplitNode
+            owned = np.zeros(cfg.num_nodes, bool)
+            owned[list(cfg.owned)] = True
+            self.node = SplitNode(DagConfig(cfg.num_nodes, cfg.window),
+                                  spec, cfg.ops_per_block, owned,
+                                  send=send, **dims)
+            self.kv = self.node.kv
+        else:
+            self.kv = SafeKV(DagConfig(cfg.num_nodes, cfg.window), spec,
+                             ops_per_block=cfg.ops_per_block, **dims)
+        # native key slot -> key name cache (split mode keys objects by
+        # NAME: slot interning order is process-local)
+        self.key_names: List[Optional[str]] = []
         # consensus-ordered key space: creates ride DAG blocks, every
         # view materializes (key -> slot) in committed total order
         # (KeySpaceManager.cs:55-113, 151-177)
         self.capacity = tcfg.num_keys
+        self.slot_capacity = dims.get("capacity")
         self.rks = ReplicatedKeySpace(cfg.num_nodes, tcfg.num_keys)
         self.known_keys: set = set()      # creates ever seen (any state)
         # wire key -> [(client_tag, home)] awaiting create materialization
@@ -130,7 +181,7 @@ class _TypeRuntime:
     def stats_snapshot(self) -> Dict[str, object]:
         """DAGStats-style snapshot for the stats command."""
         lat = self.kv.commit_latencies()
-        return {
+        snap = {
             **self.kv.stats,
             "keys": len(self.rks.tables[0]),
             "base_round": self.kv.base_round(),
@@ -138,6 +189,14 @@ class _TypeRuntime:
                 float(np.percentile(lat, 50)) if lat.size else None,
             "pending_ops": sum(len(q) for q in self.pending),
         }
+        if "element_count" in self.spec.queries:
+            # slot-capacity pressure (tombstones included): how close the
+            # fullest key is to dropping slots; compaction at GC fences
+            # (SafeKV.maybe_compact) is what keeps this bounded
+            occ = np.asarray(self.kv.query_prospective("element_count"))
+            snap["max_slot_occupancy"] = int(occ.max())
+            snap["slot_capacity"] = self.slot_capacity
+        return snap
 
 
 def _letters(op_code: int) -> str:
@@ -154,10 +213,32 @@ class JanusService:
         self.server = NativeServer(cfg.bind_addr, cfg.port, cfg.max_clients)
         self.types: Dict[int, _TypeRuntime] = {}
         self._interner = Interner()
-        for tcfg in cfg.types:
+        # client home nodes: every node locally, or this process's owned
+        # subset in split mode (clients of other nodes connect to their
+        # owning process — the reference's one-server-per-replica shape)
+        self._homes = list(cfg.owned)
+        self._fabric = None
+        self._remote_creates: deque = deque()
+        if cfg.split:
+            from janus_tpu.net.fabric import DagFabric
+            addrs = [(p.address, p.dag_port) for p in cfg.procs]
+            self._fabric = DagFabric(
+                addrs, cfg.proc_index,
+                on_type_frame=self._on_type_frame,
+                on_create=lambda ti, key, rnd, src:
+                    self._remote_creates.append((ti, key, rnd, src)))
+        self._tid_order: List[int] = []
+        for i, tcfg in enumerate(cfg.types):
             tid = self.server.register_type(tcfg.type_code, tcfg.num_keys)
-            self.types[tid] = _TypeRuntime(cfg, tcfg)
+            send = self._fabric.type_sender(i) if self._fabric else None
+            rt = _TypeRuntime(cfg, tcfg, send=send)
+            rt.index = i
+            self.types[tid] = rt
+            self._tid_order.append(tid)
         self._stats_tid = self.server.register_type("stats", 1)
+        # stable cross-process element ids (split mode): interned param
+        # id -> hashed element id
+        self._elem_cache: Dict[int, int] = {}
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self.ticks = 0
@@ -177,13 +258,26 @@ class JanusService:
         # home view (creates are serializable: slot assignment needs the
         # committed total order)
         self._waiting: List[dict] = []
+        # live count of queued/waiting items per connection id — the
+        # read-your-writes gate is O(1) per deferred read instead of a
+        # walk of every pending queue item per read per step
+        self._conn_pending: Dict[int, int] = {}
+        # replies accumulate during a step and flush as ONE native call
+        # (one TCP send per distinct connection, reply_batch)
+        self._reply_buf: List[Tuple[int, str, str]] = []
 
     # -- lifecycle -------------------------------------------------------
 
     def start(self, pump: bool = True, interval: float = 0.0) -> int:
         """Start the TCP server (returns its port) and, unless
-        ``pump=False``, a driver thread calling ``step`` continuously."""
+        ``pump=False``, a driver thread calling ``step`` continuously.
+        In split mode this first completes the DAG-plane mesh
+        (connect-all with retries) and broadcasts key material."""
         port = self.server.start()
+        if self._fabric is not None:
+            self._fabric.start()
+            for rt in self.types.values():
+                rt.node.start()
         if pump:
             self._running = True
             self._thread = threading.Thread(
@@ -211,7 +305,37 @@ class JanusService:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if self._fabric is not None:
+            self._fabric.close()
         self.server.close()
+
+    # -- split-cluster plumbing -----------------------------------------
+
+    def _on_type_frame(self, type_idx: int, data: bytes) -> None:
+        """Peer DAG bytes for one type (runs on a receive thread; the
+        SplitNode's receive buffer is thread-safe)."""
+        if 0 <= type_idx < len(self._tid_order):
+            self.types[self._tid_order[type_idx]].node.receive(data)
+
+    def _drain_remote_creates(self) -> None:
+        while self._remote_creates:
+            ti, key, rnd, src = self._remote_creates.popleft()
+            if not (0 <= ti < len(self._tid_order)):
+                continue
+            rt = self.types[self._tid_order[ti]]
+            rt.rks.register_create(src, key, rnd)
+            rt.known_keys.add(key)
+
+    def _key_str(self, rt: _TypeRuntime, tid: int, slot: int) -> str:
+        """Native key slot -> key NAME (cached). Keys are identified by
+        name service-wide: native slot interning order is process-local,
+        so a split cluster cannot key anything on it."""
+        names = rt.key_names
+        while len(names) <= slot:
+            names.append(None)
+        if names[slot] is None:
+            names[slot] = self.server.key_name(tid, slot) or f"?{slot}"
+        return names[slot]
 
     def __enter__(self):
         self.start()
@@ -225,34 +349,98 @@ class JanusService:
     def _elem_id(self, p: int) -> int:
         """Map a wire param (numeric value, or native-interned id with
         INTERN_BIT) to a device element id < SENTINEL. Small numerics map
-        to themselves; everything else interns above _BIG so literal and
-        interned values can never collide."""
+        to themselves; everything else maps above _BIG so literal and
+        interned values can never collide.
+
+        Local mode interns (exact, collision-free). Split mode must map
+        the same STRING to the same id in every process, so it hashes
+        the param's name into the ~2^30 id space — SHA-256-based, with a
+        ~2^-30-per-pair collision chance the deployment accepts (the
+        reference ships strings and pays serialization instead)."""
         if 0 <= p < _BIG:
             return int(p)
-        eid = _BIG + self._interner.intern(int(p))
-        if eid >= int(SENTINEL):
-            raise OverflowError("element id space exhausted")
+        if self._fabric is None:
+            eid = _BIG + self._interner.intern(int(p))
+            if eid >= int(SENTINEL):
+                raise OverflowError("element id space exhausted")
+            return eid
+        cached = self._elem_cache.get(int(p))
+        if cached is not None:
+            return cached
+        import hashlib
+        if p >= INTERN_BIT:
+            s = self.server.value_name(int(p - INTERN_BIT))
+            data = s.encode() if s is not None else str(int(p)).encode()
+        else:
+            data = str(int(p)).encode()  # negative numeric literal
+        h = int.from_bytes(hashlib.sha256(data).digest()[:8], "little")
+        eid = _BIG + h % (int(SENTINEL) - _BIG)
+        self._elem_cache[int(p)] = eid
         return eid
 
     # -- dispatch --------------------------------------------------------
 
+    def _reply(self, tag: int, result: str, status: str) -> None:
+        """Queue one reply; the whole step's replies flush as a single
+        native reply_batch call (one TCP send per distinct connection —
+        the reference pays a channel write + sender-thread wakeup per
+        reply, ClientInterface.cs:37-77)."""
+        self._reply_buf.append((tag, result, status))
+
+    def _flush_replies(self) -> None:
+        if self._reply_buf:
+            buf, self._reply_buf = self._reply_buf, []
+            self.server.reply_batch(buf)
+
+    def _pend_inc(self, tag: int) -> None:
+        c = int(tag) >> 32
+        self._conn_pending[c] = self._conn_pending.get(c, 0) + 1
+
+    def _pend_dec(self, tag: int) -> None:
+        c = int(tag) >> 32
+        v = self._conn_pending.get(c, 0) - 1
+        if v <= 0:
+            self._conn_pending.pop(c, None)
+        else:
+            self._conn_pending[c] = v
+
     def step(self) -> bool:
         """Drain the native queue, execute one protocol round, send
         replies. Returns True if any client work was processed."""
+        try:
+            return self._step_inner()
+        finally:
+            # flush even when the step raises: replies already queued
+            # (error replies, unsafe acks, stats) must reach their
+            # clients even while a poisoned request keeps one type's
+            # device path failing — the pump swallows the exception, so
+            # an end-of-body flush alone would strand them forever
+            self._flush_replies()
+
+    def _step_inner(self) -> bool:
         n = self.cfg.num_nodes
         t_step = time.perf_counter()
+        self._drain_remote_creates()
         polled = self.server.poll_batch(4096)
         count = len(polled["client_tag"])
         if count:
             self.perf.add(count)
         items = self._waiting
         self._waiting = []
+        for it in items:
+            # re-ingestion below re-counts any item that stays queued
+            self._pend_dec(it["tag"])
         for i in range(count):
+            tid = int(polled["type_id"][i])
+            rt = self.types.get(tid)
+            slot = int(polled["key_slot"][i])
             items.append({
                 "tag": int(polled["client_tag"][i]),
-                "tid": int(polled["type_id"][i]),
+                "tid": tid,
                 "letters": _letters(int(polled["op_code"][i])),
-                "key": int(polled["key_slot"][i]),
+                # keys travel by NAME from here on (process-local native
+                # slots cannot identify a key across a split cluster)
+                "key": self._key_str(rt, tid, slot) if rt else slot,
                 "safe": bool(polled["is_safe"][i]),
                 "p0": int(polled["p0"][i]),
                 "p1": int(polled["p1"][i]),
@@ -281,15 +469,14 @@ class JanusService:
         self._deferred_reads = []
         for it in queue:
             rt = self.types[it["tid"]]
-            home = (it["tag"] >> 32) % n
+            home = self._homes[(it["tag"] >> 32) % len(self._homes)]
             slot = rt.rks.slot(home, it["key"])
             if slot is None or self._conn_has_pending(it["tag"] >> 32):
                 self._deferred_reads.append(it)
                 busy = True
                 continue
-            self.server.reply(it["tag"],
-                              self._read(rt, slot, home, it["letters"], it),
-                              "ok")
+            self._reply(it["tag"],
+                        self._read(rt, slot, home, it["letters"], it), "ok")
         self._step_ms.append(1e3 * (time.perf_counter() - t_step))
         if len(self._step_ms) > 10_000:
             del self._step_ms[:5_000]
@@ -299,25 +486,25 @@ class JanusService:
         """Route one wire op: reply, queue for a block, or defer."""
         n = self.cfg.num_nodes
         tag, letters = it["tag"], it["letters"]
-        home = (tag >> 32) % n
+        home = self._homes[(tag >> 32) % len(self._homes)]
         if it["tid"] == self._stats_tid:
-            self.server.reply(tag, self._stats_report(), "ok")
+            self._reply(tag, self._stats_report(), "ok")
             return
         rt = self.types.get(it["tid"])
         if rt is None:
-            self.server.reply(tag, "error: unknown type", "err")
+            self._reply(tag, "error: unknown type", "err")
             return
         key = it["key"]
         if letters == "s":
             if rt.rks.slot(home, key) is not None:
-                self.server.reply(tag, "success", "ok")
+                self._reply(tag, "success", "ok")
                 return
             # capacity gate counts every distinct key ever admitted
             # (committed AND in flight) — checking only committed tables
             # would admit overflow creates that materialization must then
             # silently skip, hanging their clients forever
             if key not in rt.known_keys and len(rt.known_keys) >= rt.capacity:
-                self.server.reply(tag, "error: key space full", "err")
+                self._reply(tag, "error: key space full", "err")
                 return
             # reply deferred until the create commits in the home view —
             # slot assignment is total-order position, so creates are
@@ -327,20 +514,22 @@ class JanusService:
             if key not in rt.known_keys:
                 rt.known_keys.add(key)
                 rt.pending[home].append((None, tag, False, key))
+                self._pend_inc(tag)
             return
         if key not in rt.known_keys:
-            self.server.reply(tag, "error: no such key", "err")
+            self._reply(tag, "error: no such key", "err")
             return
         if letters in ("gp", "gs", "sp", "ss"):
             reads.append(it)
             return
         op_id = rt.op_id(letters)
         if op_id is None:
-            self.server.reply(tag, f"error: bad op {letters!r}", "err")
+            self._reply(tag, f"error: bad op {letters!r}", "err")
             return
         slot = rt.rks.slot(home, key)
         if slot is None:
             self._waiting.append(it)  # created, not yet committed here
+            self._pend_inc(tag)
             return
         if rt.spec.type_code == "rga" and self._conn_has_pending(tag >> 32):
             # position-based ops resolve their anchor against the home
@@ -348,26 +537,21 @@ class JanusService:
             # connection must board (and fast-path apply) first or the
             # index would resolve against a stale document
             self._waiting.append(it)
+            self._pend_inc(tag)
             return
         fields = self._op_fields(rt, op_id, slot, home, it)
         if fields is None:
-            self.server.reply(tag, "error: bad param", "err")
+            self._reply(tag, "error: bad param", "err")
             return
         rt.pending[home].append((fields, tag, it["safe"], None))
+        self._pend_inc(tag)
         if not it["safe"]:
             # immediate reply for unsafe updates (the op is queued on
             # the home node's next block; ClientInterface.cs:233-242)
-            self.server.reply(tag, "success", "ok")
+            self._reply(tag, "success", "ok")
 
     def _conn_has_pending(self, conn_id: int) -> bool:
-        return any(
-            (int(tag) >> 32) == conn_id
-            for rt in self.types.values()
-            for q in rt.pending
-            for (_f, tag, _safe, _ck) in q
-        ) or any(
-            (it["tag"] >> 32) == conn_id for it in self._waiting
-        )
+        return self._conn_pending.get(conn_id, 0) > 0
 
     def _op_fields(self, rt: _TypeRuntime, op_id: int, slot: int, home: int,
                    it: dict) -> Optional[Dict[str, int]]:
@@ -476,7 +660,7 @@ class JanusService:
             still = [(tag, home) for tag, home in waiters if home != v]
             for tag, home in waiters:
                 if home == v:
-                    self.server.reply(tag, "success", "ok")
+                    self._reply(tag, "success", "ok")
             if still:
                 rt.create_tags[key] = still
             else:
@@ -491,7 +675,12 @@ class JanusService:
         n, B = cfg.num_nodes, cfg.ops_per_block
         had_ops = any(rt.pending)
         if not had_ops:
-            # idle keep-alive round: cached device batch, nothing recorded
+            # idle keep-alive round: cached device batch, nothing
+            # recorded (split mode must still step — the wire exchange
+            # and remote ingest ride every round)
+            if rt.node is not None:
+                rt.node.step(record=False)
+                return False
             import jax
             if rt.idle_batch is None:
                 rt.idle_batch = jax.device_put(base.make_op_batch(
@@ -520,15 +709,30 @@ class JanusService:
         # record only payload-bearing blocks in latency stats; idle
         # keep-alive rounds must not grow host logs or dilute metrics
         record = np.asarray([bool(placed[v]) for v in range(n)])
-        info = rt.kv.step(base.make_op_batch(**batch), safe=safe,
-                          record=record)
+        ops = base.make_op_batch(**batch)
+        if rt.node is not None:
+            info = rt.node.step(ops, safe=safe, record=record)
+            if info is None:  # key exchange incomplete: requeue all
+                for v in range(n):
+                    for item in reversed(taken[v]):
+                        rt.pending[v].appendleft(item)
+                return had_ops
+        else:
+            info = rt.kv.step(ops, safe=safe, record=record)
         accepted, slots = info["accepted"], info["slot"]
         for v in range(n):
             if accepted[v]:
                 for b, is_safe, tag, create_key in placed[v]:
+                    self._pend_dec(tag)
                     if create_key is not None:
-                        rt.rks.register_create(v, create_key,
-                                               int(info["round"][v]))
+                        rnd = int(info["round"][v])
+                        rt.rks.register_create(v, create_key, rnd)
+                        if self._fabric is not None:
+                            # replicate the (key -> block) binding; it
+                            # arrives >= 2 protocol round-trips before
+                            # any peer view can commit the block
+                            self._fabric.send_create(
+                                rt.index, create_key, rnd, v)
                     if is_safe:
                         rt.ack_map[(int(slots[v]), v, b)] = tag
             else:
@@ -549,7 +753,7 @@ class JanusService:
                 tag = rt.ack_map.pop((s, v, b))
                 # deferred safe-update ack (NotifySafeUpdateComplete,
                 # ClientInterface.cs:186-190)
-                self.server.reply(tag, "success", "su")
+                self._reply(tag, "success", "su")
 
     def _read(self, rt: _TypeRuntime, slot: int, home: int, letters: str,
               it: dict) -> str:
@@ -625,13 +829,15 @@ class JanusService:
 
 def main(argv=None) -> None:
     """Server entry point (the Program.cs analog, Program.cs:10-69):
-    ``python -m janus_tpu.net.service [config.json]`` starts the full
-    service and runs until SIGINT."""
+    ``python -m janus_tpu.net.service [config.json [proc_index]]``
+    starts the full service (one split-cluster process when the config
+    has ``procs`` and a proc_index is given) and runs until SIGINT."""
     import signal
     import sys
 
     args = sys.argv[1:] if argv is None else argv
-    cfg = (JanusConfig.from_json(open(args[0]).read())
+    proc_index = int(args[1]) if len(args) > 1 else 0
+    cfg = (JanusConfig.from_json(open(args[0]).read(), proc_index)
            if args else JanusConfig(port=5050))
     stop = {"flag": False}
     # install before the banner: a launcher may SIGINT the moment it

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Split-cluster launcher: one JanusService process per cluster-JSON
+entry, full-mesh DAG plane, per-process client ports.
+
+Reference: BFT-CRDT-Client/scripts/start_servers.py — generates per-node
+cluster JSONs, spawns one server process per replica, writes pid files,
+stop/status commands (:27-328). Here one cluster config describes every
+process; each process is started with its index.
+
+Usage:
+  python scripts/start_split_cluster.py start cluster.json [--logdir DIR]
+  python scripts/start_split_cluster.py stop  [--logdir DIR]
+  python scripts/start_split_cluster.py status [--logdir DIR]
+
+Cluster JSON (JanusConfig.from_json shape + per-proc client ports):
+  {"num_nodes": 4, "window": 8, "ops_per_block": 16,
+   "types": [{"type_code": "pnc", "dims": {"num_keys": 64}}],
+   "procs": [
+     {"address": "127.0.0.1", "dag_port": 7100, "owned": [0, 1],
+      "client_port": 5100},
+     {"address": "127.0.0.1", "dag_port": 7101, "owned": [2, 3],
+      "client_port": 5101}]}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+DEFAULT_LOGDIR = "/tmp/janus_split"
+
+
+def start(cluster_json: str, logdir: str) -> None:
+    os.makedirs(logdir, exist_ok=True)
+    cfg = json.loads(open(cluster_json).read())
+    procs = cfg.get("procs", [])
+    if not procs:
+        sys.exit("config has no 'procs' — nothing to split")
+    pids = []
+    for i, p in enumerate(procs):
+        per = dict(cfg)
+        per["proc_index"] = i
+        per["port"] = int(p.get("client_port", 0))
+        per["bind_addr"] = p.get("address", "127.0.0.1")
+        cfg_path = os.path.join(logdir, f"proc{i}.json")
+        with open(cfg_path, "w") as f:
+            json.dump(per, f)
+        log = open(os.path.join(logdir, f"proc{i}.log"), "w")
+        child = subprocess.Popen(
+            [sys.executable, "-m", "janus_tpu.net.service", cfg_path, str(i)],
+            stdout=log, stderr=subprocess.STDOUT,
+        )
+        pids.append(child.pid)
+        print(f"proc {i}: pid {child.pid} client={per['bind_addr']}:"
+              f"{per['port']} dag={p['address']}:{p['dag_port']} "
+              f"owned={p['owned']}")
+    with open(os.path.join(logdir, "pids"), "w") as f:
+        f.write("\n".join(map(str, pids)))
+    print(f"{len(pids)} processes started; logs in {logdir}")
+
+
+def _read_pids(logdir: str):
+    path = os.path.join(logdir, "pids")
+    if not os.path.exists(path):
+        return []
+    return [int(x) for x in open(path).read().split()]
+
+
+def stop(logdir: str) -> None:
+    for pid in _read_pids(logdir):
+        try:
+            os.kill(pid, signal.SIGINT)
+            print(f"SIGINT -> {pid}")
+        except ProcessLookupError:
+            print(f"{pid} already gone")
+    deadline = time.time() + 10
+    for pid in _read_pids(logdir):
+        while time.time() < deadline:
+            try:
+                os.kill(pid, 0)
+                time.sleep(0.2)
+            except ProcessLookupError:
+                break
+        else:
+            os.kill(pid, signal.SIGKILL)
+            print(f"SIGKILL -> {pid}")
+
+
+def status(logdir: str) -> None:
+    for pid in _read_pids(logdir):
+        try:
+            os.kill(pid, 0)
+            print(f"{pid} running")
+        except ProcessLookupError:
+            print(f"{pid} dead")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("command", choices=["start", "stop", "status"])
+    ap.add_argument("cluster_json", nargs="?")
+    ap.add_argument("--logdir", default=DEFAULT_LOGDIR)
+    args = ap.parse_args()
+    if args.command == "start":
+        if not args.cluster_json:
+            sys.exit("start needs a cluster JSON")
+        start(args.cluster_json, args.logdir)
+    elif args.command == "stop":
+        stop(args.logdir)
+    else:
+        status(args.logdir)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,267 @@
+"""Split-cluster deployment tests: payload-carrying signed blocks across
+process-shaped endpoints (reference: one OS process per replica wired by
+Cluster/CMNode TCP, DAGMessage.cs:68-114 blocks-carry-updates,
+Block.cs:45-88 digests/signatures, DAG.cs:612-621 block-query repair).
+
+Endpoints here exchange REAL serialized frames; the transports are
+in-memory pipes (deterministic) and loopback TCP (the wire shape).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from janus_tpu.consensus.dag import DagConfig
+from janus_tpu.models import base, orset, pncounter
+from janus_tpu.net.dagplane import TcpPeer
+from janus_tpu.net.splitnode import SplitNode
+
+N, W, B = 4, 8, 2
+K = 4
+
+
+def _pnc_ops(nodes, amount=5):
+    op = np.zeros((N, B), np.int32)
+    for v in nodes:
+        op[v, :] = pncounter.OP_INC
+    return base.make_op_batch(
+        op=op, key=np.zeros((N, B), np.int32),
+        a0=np.full((N, B), amount, np.int32),
+        writer=np.broadcast_to(np.arange(N, dtype=np.int32)[:, None],
+                               (N, B)).copy())
+
+
+class _Pipes:
+    """In-memory broadcast fabric between endpoints, with an optional
+    per-sender corruption hook."""
+
+    def __init__(self, count, corrupt=None):
+        self.boxes = [[] for _ in range(count)]
+        self.corrupt = corrupt or {}
+
+    def sender(self, idx):
+        def send(data: bytes):
+            fn = self.corrupt.get(idx)
+            payload = fn(data) if fn else data
+            for j, box in enumerate(self.boxes):
+                if j != idx:
+                    box.append(payload)
+        return send
+
+    def pump(self, nodes):
+        moved = True
+        while moved:
+            moved = False
+            for j, node in enumerate(nodes):
+                if self.boxes[j]:
+                    moved = True
+                    for d in self.boxes[j]:
+                        node.receive(d)
+                    self.boxes[j].clear()
+
+
+def _mk(owned, send, spec=pncounter.SPEC, **dims):
+    if not dims:
+        dims = {"num_keys": K, "num_writers": N}
+    return SplitNode(DagConfig(N, W), spec, B, owned, send=send, **dims)
+
+
+def test_two_process_payload_replication():
+    """VERDICT round-3 item 2: an op submitted at process A must read
+    back from process B's STABLE state — blocks carry their payloads."""
+    pipes = _Pipes(2)
+    a = _mk([1, 1, 0, 0], pipes.sender(0))
+    b = _mk([0, 0, 1, 1], pipes.sender(1))
+    nodes = [a, b]
+    a.start(); b.start(); pipes.pump(nodes)
+
+    safe = np.zeros((N, B), bool)
+    safe[0] = True
+    # first step completes the key exchange (inits drain inside step)
+    a.step(); pipes.pump(nodes)
+    b.step(); pipes.pump(nodes)
+    assert a.ready and b.ready
+
+    # submit with retry: a slot can be sealed by an earlier idle round
+    # (the service requeues on a False accept bit the same way)
+    acked = False
+    boarded = False
+    for t in range(30):
+        info = a.step(None if boarded else _pnc_ops([0, 1]),
+                      safe=None if boarded else safe)
+        boarded = boarded or (info is not None
+                              and bool(info["accepted"][:2].all()))
+        pipes.pump(nodes)
+        b.step()
+        pipes.pump(nodes)
+        acked = acked or a.kv.safe_acks()[:, 0, :].any()
+    assert boarded
+    # both of A's nodes incremented key 0 by 5, twice (B lanes)
+    expect = 2 * B * 5
+    a_stable = np.asarray(a.query_stable("get"))[:2, 0]
+    b_stable = np.asarray(b.query_stable("get"))[2:, 0]
+    np.testing.assert_array_equal(a_stable, expect)
+    np.testing.assert_array_equal(b_stable, expect)
+    # A's safe ops were acked at commit (the deferred-reply signal)
+    assert acked
+    # every frame verified, nothing dropped, GC advanced on both sides
+    for n_ in nodes:
+        assert n_.stats["verified_bad"] == 0
+        assert n_.kv.base_round() > 2
+    # committed total orders agree across processes (prefix equality)
+    oa = a.kv.ordered_commits(0)
+    ob = b.kv.ordered_commits(2)
+    common = min(len(oa), len(ob))
+    assert common > 10
+    assert oa[:common] == ob[:common]
+
+
+def test_orset_capture_payload_across_processes():
+    """Effect-captured ops (OR-Set removes carry observed tags) must
+    survive serialization: an add+remove at A leaves B's stable empty."""
+    dims = {"num_keys": 2, "capacity": 16, "rm_capacity": 4}
+    pipes = _Pipes(2)
+    a = _mk([1, 1, 0, 0], pipes.sender(0), orset.SPEC, **dims)
+    b = _mk([0, 0, 1, 1], pipes.sender(1), orset.SPEC, **dims)
+    nodes = [a, b]
+    a.start(); b.start(); pipes.pump(nodes)
+
+    def drive(ops=None):
+        a.step(ops)
+        pipes.pump(nodes)
+        b.step()
+        pipes.pump(nodes)
+
+    def drive_until_boarded(ops, node_idx=0):
+        for _ in range(10):
+            info = a.step(ops)
+            pipes.pump(nodes)
+            b.step()
+            pipes.pump(nodes)
+            if info is not None and info["accepted"][node_idx]:
+                return
+        raise AssertionError("ops never boarded a block")
+
+    add = base.make_op_batch(
+        op=np.asarray([[orset.OP_ADD, 0]] + [[0, 0]] * 3, np.int32),
+        key=np.zeros((N, B), np.int32),
+        a0=np.full((N, B), 42, np.int32),
+        a1=np.zeros((N, B), np.int32),
+        a2=np.asarray([[1, 0]] + [[0, 0]] * 3, np.int32),
+        writer=np.broadcast_to(np.arange(N, dtype=np.int32)[:, None],
+                               (N, B)).copy())
+    drive_until_boarded(add)
+    for _ in range(14):
+        drive()
+    # the add crossed: B sees 42 in its prospective/stable
+    assert bool(np.asarray(b.query_stable("contains", 0, 42))[2])
+    rm = base.make_op_batch(
+        op=np.asarray([[orset.OP_REMOVE, 0]] + [[0, 0]] * 3, np.int32),
+        key=np.zeros((N, B), np.int32),
+        a0=np.full((N, B), 42, np.int32),
+        writer=np.broadcast_to(np.arange(N, dtype=np.int32)[:, None],
+                               (N, B)).copy())
+    drive_until_boarded(rm)
+    for _ in range(14):
+        drive()
+    got = np.asarray(b.query_stable("contains", 0, 42))[2:]
+    assert not got.any(), "captured remove did not replicate"
+
+
+def test_tampered_blocks_dropped_liveness_holds():
+    """VERDICT round-3 item 7: a peer whose block frames are corrupted
+    in transit is detected (signature verification) and excluded; the
+    honest 2f+1 keep committing."""
+
+    def flip(data: bytes) -> bytes:
+        # corrupt one byte well inside every frame (hits edges/ops
+        # payload bytes; the signature then fails everywhere honest)
+        mut = bytearray(data)
+        if len(mut) > 24:
+            mut[20] ^= 0xFF
+        return bytes(mut)
+
+    pipes = _Pipes(4, corrupt={3: flip})
+    nodes = [_mk([i == j for j in range(N)], pipes.sender(i))
+             for i in range(N)]
+    for n_ in nodes:
+        n_.start()
+    pipes.pump(nodes)
+    boarded = [False] * N
+    for t in range(60):
+        for i, n_ in enumerate(nodes):
+            info = n_.step(None if boarded[i] else _pnc_ops([i]))
+            if not boarded[i] and info is not None:
+                boarded[i] = bool(info["accepted"][i])
+        pipes.pump(nodes)
+    assert all(boarded)
+
+    honest = nodes[:3]
+    # honest nodes detected the corruption and kept advancing: a round
+    # takes ~4 step+pump exchanges across 4 endpoints, so 60 iterations
+    # reach ~14 rounds — past the W=8 window, which proves the GC
+    # frontier moves (the ring would deadlock rounds at W-1 otherwise)
+    assert any(n_.stats["verified_bad"] > 0 for n_ in honest)
+    for n_ in honest:
+        assert int(np.asarray(n_.kv.dag["node_round"])[n_.owned_idx[0]]) > 10
+    # node 3's blocks never commit in honest views (they never certify)
+    for n_ in honest:
+        v = int(n_.owned_idx[0])
+        assert all(src != 3 for _r, src in n_.kv.ordered_commits(v))
+    # honest ops still committed and replicated everywhere honest
+    for n_ in honest:
+        vals = np.asarray(n_.query_stable("get"))[n_.owned_idx[0], 0]
+        assert int(vals) == 3 * B * 5  # nodes 0..2 each +5 per lane
+
+
+def test_split_over_loopback_tcp():
+    """The same two-process exchange over real sockets (TcpPeer), the
+    CMNode/ManagerServer wire shape."""
+    import socket
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    peers = {}
+    a = _mk([1, 1, 0, 0], lambda d: peers["a"].send(d))
+    b = _mk([0, 0, 1, 1], lambda d: peers["b"].send(d))
+
+    accepted = {}
+
+    def accept():
+        conn, _ = srv.accept()
+        accepted["sock"] = conn
+
+    th = threading.Thread(target=accept)
+    th.start()
+    peers["b"] = TcpPeer.connect("127.0.0.1", port, b.receive)
+    th.join()
+    peers["a"] = TcpPeer(accepted["sock"], a.receive)
+
+    try:
+        a.start(); b.start()
+        deadline = time.monotonic() + 60
+        while not (a.ready and b.ready):
+            a.step(); b.step()
+            if time.monotonic() > deadline:
+                pytest.fail("key exchange did not complete")
+            time.sleep(0.01)
+        boarded = False
+        for t in range(40):
+            info = a.step(None if boarded else _pnc_ops([0, 1]))
+            boarded = boarded or (info is not None
+                                  and bool(info["accepted"][:2].all()))
+            b.step()
+            time.sleep(0.002)
+        assert boarded
+        expect = 2 * B * 5
+        b_stable = np.asarray(b.query_stable("get"))[2:, 0]
+        np.testing.assert_array_equal(b_stable, expect)
+        assert b.stats["verified_bad"] == 0
+    finally:
+        peers["a"].close()
+        peers["b"].close()
+        srv.close()
