@@ -40,6 +40,12 @@ class BankingConfig:
     mix: Tuple[float, float, float, float] = (0.4, 0.3, 0.2, 0.1)
     access: str = "uniform"  # uniform | normal
     initial_balance: int = 1000
+    # WAN emulation: one-way injected delay per request/reply, sampled
+    # N(wan_delay_ms, wan_jitter_ms) per direction — the reference's
+    # banking numbers are under netem 50 ms +/- 10 ms (paper §6.3
+    # Fig 12); set (50, 10) to reproduce that configuration
+    wan_delay_ms: float = 0.0
+    wan_jitter_ms: float = 0.0
     seed: int = 0
 
     @classmethod
@@ -67,6 +73,9 @@ class BankingResults:
             "tps": round(self.total_txns / self.elapsed_s, 1)
             if self.elapsed_s else 0.0,
             "failed_withdrawals": self.failed_withdrawals,
+            "wan_delay_ms": self.cfg.wan_delay_ms,
+            "wan_jitter_ms": self.cfg.wan_jitter_ms,
+            "clients": self.cfg.clients,
             "latency": {t: s.summary() for t, s in self.stats.items()},
         }
 
@@ -121,6 +130,19 @@ def run_banking(cfg: BankingConfig) -> BankingResults:
         c = JanusClient("127.0.0.1", port, timeout=120)
         local: List[Tuple[str, float]] = []
         failed = 0
+
+        def req(*a, **kw):
+            # WAN emulation: request and reply each ride one sampled
+            # one-way delay (netem-shaped; paper §6.3)
+            if cfg.wan_delay_ms:
+                time.sleep(max(0.0, rng.normal(
+                    cfg.wan_delay_ms, cfg.wan_jitter_ms)) / 1e3)
+            out = c.request(*a, timeout=120, **kw)
+            if cfg.wan_delay_ms:
+                time.sleep(max(0.0, rng.normal(
+                    cfg.wan_delay_ms, cfg.wan_jitter_ms)) / 1e3)
+            return out
+
         barrier.wait()
         for _ in range(cfg.txns_per_client):
             r = rng.random() * sum(cfg.mix)
@@ -128,26 +150,24 @@ def run_banking(cfg: BankingConfig) -> BankingResults:
             amt = int(rng.integers(1, 100))
             t1 = time.perf_counter()
             if r < w_view:
-                c.request("pnc", src, "gp", timeout=120)
+                req("pnc", src, "gp")
                 kind = "view"
             elif r < w_view + w_dep:
-                c.request("pnc", src, "i", [str(amt)], timeout=120)
+                req("pnc", src, "i", [str(amt)])
                 kind = "deposit"
             elif r < w_view + w_dep + w_tr:
                 # transfer: SAFE debit source, then credit destination
                 # (the credit is chained after the consensus ack,
                 # BankingWorload.cs transfer callback chain)
                 dst = f"acct{_account(rng, cfg)}"
-                c.request("pnc", src, "d", [str(amt)], is_safe=True,
-                          timeout=120)
-                c.request("pnc", dst, "i", [str(amt)], timeout=120)
+                req("pnc", src, "d", [str(amt)], is_safe=True)
+                req("pnc", dst, "i", [str(amt)])
                 kind = "transfer"
             else:
                 # withdraw: stable read, then safe debit if covered
-                bal = int(c.request("pnc", src, "gs", timeout=120)["result"])
+                bal = int(req("pnc", src, "gs")["result"])
                 if bal >= amt:
-                    c.request("pnc", src, "d", [str(amt)], is_safe=True,
-                              timeout=120)
+                    req("pnc", src, "d", [str(amt)], is_safe=True)
                 else:
                     failed += 1
                 kind = "withdraw"
@@ -178,9 +198,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", help="JSON BankingConfig file")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--wan", action="store_true",
+                    help="emulate the reference's WAN: 50 +/- 10 ms "
+                         "per direction (paper §6.3)")
     args = ap.parse_args(argv)
     cfg = (BankingConfig.from_json(open(args.config).read())
            if args.config else BankingConfig())
+    if args.wan:
+        cfg = dataclasses.replace(cfg, wan_delay_ms=50.0, wan_jitter_ms=10.0)
     res = run_banking(cfg)
     if args.json:
         print(json.dumps(res.to_dict()))

@@ -45,12 +45,18 @@ class BenchConfig:
     # wire mode
     clients: int = 4
     ops_per_client: int = 200
+    # requests in flight per client connection: 1 = closed loop; the
+    # reference benchmark is effectively open-loop (async receive with
+    # per-thread batches, BenchmarkRunners.cs:185-256), which is what a
+    # deep pipeline reproduces
+    pipeline: int = 1
     # op mix (BenchmarkConfig.opsRatio): weights by op class
     ops_ratio: Tuple[float, float, float] = (0.5, 0.5, 0.0)  # get/update/safe
     key_pattern: str = "uniform"      # uniform | zipf | normal
     zipf_theta: float = 0.99
     byzantine: int = 0                # nodes injecting invalid signatures
     invalid_rate: float = 0.5
+    crashed: int = 0                  # crash-fault nodes (paper Fig 11)
     # OR-Set per-key tag capacity. NOT scaled with num_objects: the
     # effect-capture payload is [W, N, B, rm_capacity] int32 per extra
     # field, so these multiply the whole consensus op buffer
@@ -58,6 +64,12 @@ class BenchConfig:
     # captured tags per remove op; exact while elements keep fewer live
     # tags than this (the bench add/remove mix keeps ~1-2)
     orset_rm_capacity: int = 16
+    # RGA replay churn shape: each element is deleted rga_delete_lag
+    # ticks after its insert, and every replica compacts (identically,
+    # at full convergence) every rga_compact_every ticks — live state
+    # stays bounded while the cumulative op log runs to millions
+    rga_delete_lag: int = 2
+    rga_compact_every: int = 4
     seed: int = 0
 
     @classmethod
@@ -206,6 +218,10 @@ def run_tensor(cfg: BenchConfig) -> Results:
             a1=tags[..., 0], a2=tags[..., 1])
 
     planes = {}
+    if cfg.byzantine and cfg.crashed:
+        raise ValueError(
+            "byzantine + crashed in one run needs SecureCluster's "
+            "fetch-mode crash modeling; configure them separately")
     if cfg.byzantine:
         from janus_tpu.consensus.integrity import IntegrityPlane, SecureCluster
         byz = np.zeros(n, bool)
@@ -220,8 +236,20 @@ def run_tensor(cfg: BenchConfig) -> Results:
 
     safe_frac = cfg.ops_ratio[2] / max(sum(cfg.ops_ratio[1:]), 1e-9)
     safe = rng.random((n, B)) < safe_frac
+    # crash faults: the last `crashed` nodes neither create, sign, nor
+    # receive (paper §6.2 Fig 11's experiment shape); their op lanes and
+    # safe flags are zeroed so only live-node work is counted
+    active = None
+    if cfg.crashed:
+        active = np.ones(n, bool)
+        active[-cfg.crashed:] = False
+        safe = safe & active[:, None]
     batches = {code: [gen_batch(code) for _ in range(4)]
                for code, _, _ in specs}
+    if active is not None:
+        for blist in batches.values():
+            for bt in blist:
+                bt["op"] = np.where(active[:, None], bt["op"], 0)
 
     def fetch(packed):
         return np.asarray(packed), time.perf_counter()
@@ -240,6 +268,7 @@ def run_tensor(cfg: BenchConfig) -> Results:
                     secure.step(batch, safe=safe, record=record)
                 else:
                     packed, meta = kv.step_dispatch(batch, safe=safe,
+                                                    active=active,
                                                     record=record)
                     inflight.append((kv, pool.submit(fetch, packed), meta))
                     while len(inflight) > 8:
@@ -305,7 +334,8 @@ def run_wire(cfg: BenchConfig) -> Results:
                                         "capacity": cfg.orset_capacity}))
     svc = JanusService(JanusConfig(
         num_nodes=cfg.num_nodes, window=cfg.window,
-        ops_per_block=max(64, cfg.ops_per_client // 4), types=tuple(tcs)))
+        ops_per_block=cfg.ops_per_block, max_clients=cfg.clients + 8,
+        types=tuple(tcs)))
     port = svc.start()
     lock = threading.Lock()
     barrier = threading.Barrier(cfg.clients + 1)
@@ -320,23 +350,35 @@ def run_wire(cfg: BenchConfig) -> Results:
         for k in my_keys[:8]:  # create a working set
             c.request(code, k, "s")
         local: List[Tuple[str, float]] = []
+        from collections import deque
+        inflight: deque = deque()
+
+        def drain(limit: int):
+            while len(inflight) > limit:
+                cls_, seq, t1 = inflight.popleft()
+                c.wait(seq, timeout=120)
+                local.append((cls_, 1e3 * (time.perf_counter() - t1)))
+
         barrier.wait()
         for i in range(cfg.ops_per_client):
             r = rng.random() * (get_w + upd_w + safe_w)
             key = my_keys[int(_keys(rng, cfg, ())) % 8]
             t1 = time.perf_counter()
             if r < get_w:
-                c.request(code, key, "gp", ["1"] if code == "orset" else [])
+                seq = c.send(code, key, "gp",
+                             ["1"] if code == "orset" else [])
                 cls_ = "get"
             elif r < get_w + upd_w:
                 opc = "i" if code == "pnc" else "a"
-                c.request(code, key, opc, ["1"])
+                seq = c.send(code, key, opc, ["1"])
                 cls_ = "update"
             else:
                 opc = "d" if code == "pnc" else "a"
-                c.request(code, key, opc, ["1"], is_safe=True)
+                seq = c.send(code, key, opc, ["1"], is_safe=True)
                 cls_ = "safeUpdate"
-            local.append((cls_, 1e3 * (time.perf_counter() - t1)))
+            inflight.append((cls_, seq, t1))
+            drain(max(0, cfg.pipeline - 1))
+        drain(0)
         c.close()
         with lock:
             for cls_, ms in local:
@@ -359,12 +401,17 @@ def run_wire(cfg: BenchConfig) -> Results:
 
 
 def run_rga_replay(cfg: BenchConfig) -> Results:
-    """BASELINE config 5: collaborative-doc trace replay across emulated
-    replicas — every replica applies its own insert batch (Lamport
-    counters minted in-kernel), then one anti-entropy tick fully
-    propagates via the butterfly of sorted slot-union joins. Measures
-    fully-converged sequence-ops/s; the linearization (path-key sort) is
-    timed once at the end as the read cost."""
+    """BASELINE config 5: collaborative-doc CHURN replay across emulated
+    replicas — every tick each replica inserts (Lamport counters minted
+    in-kernel) and deletes its own elements from ``rga_delete_lag``
+    ticks ago; one anti-entropy tick fully propagates via the butterfly
+    of sorted slot-union joins, and every ``rga_compact_every`` ticks
+    all replicas compact identically at the full-convergence fence. The
+    cumulative op log runs to millions while live state stays bounded —
+    the editing-shaped regime where the reference's unbounded growth
+    dies (196 MB messages, paper §6.2) and compaction is what keeps this
+    design alive. Measures fully-converged sequence-ops/s; linearization
+    (path-key sort) is timed at the end as the read cost."""
     import jax
 
     from janus_tpu.models import base as mbase, rga
@@ -373,44 +420,69 @@ def run_rga_replay(cfg: BenchConfig) -> Results:
 
     res = Results(cfg)
     rng = np.random.default_rng(cfg.seed)
-    R, B, K = cfg.num_nodes, cfg.ops_per_block, cfg.num_objects
-    # every replica converges to the UNION of all replicas' inserts, so
-    # each doc must hold R*B*ticks/K unique elements — the replica
-    # factor bounds how much trace one chip's HBM can replay at full
-    # convergence (state is R x K x cap slots)
-    cap = (R * B * cfg.ticks) // K + 64
+    R, K = cfg.num_nodes, cfg.num_objects
+    L = max(1, cfg.ops_per_block // 2)   # insert lanes (= delete lanes)
+    D = cfg.rga_delete_lag
+    C = cfg.rga_compact_every
+    assert L <= K, "insert lanes per replica must not exceed docs"
+    ins_per_doc_tick = R * L // K
+    # live elements per doc ~ inserts x delete lag; tombstones linger at
+    # most one compaction period
+    cap = ins_per_doc_tick * (D + C + 2)
     state = replicated_init(rga.SPEC, R, num_keys=K, capacity=cap,
                             max_depth=8)
     tick = jit_tick(rga.SPEC)
+    compact_all = jax.jit(jax.vmap(rga.compact))
 
-    def gen(offset: int):
-        shape = (R, B)
-        # balanced doc assignment: capacity is sized to the MEAN load
-        # per doc, so the trace spreads exactly evenly (uniform-random
-        # keys overflow the unlucky docs and silently drop elements)
-        key = ((np.arange(R)[:, None] * B + np.arange(B)[None, :] + offset)
-               % K).astype(np.int32)
+    vs = np.arange(R, dtype=np.int32)[:, None]
+    js = np.arange(L, dtype=np.int32)[None, :]
+
+    def gen(t: int):
+        """Insert lanes: doc (v+j+t)%K, anchored at the root (append
+        log); delete lanes: each replica deletes ITS OWN insert from
+        tick t-D — deterministic ids because every doc takes at least
+        one insert per tick, so the converged per-doc Lamport counter
+        after tick t' is exactly t'+1."""
+        shape = (R, 2 * L)
+        op = np.zeros(shape, np.int32)
+        key = np.zeros(shape, np.int32)
+        a0 = np.zeros(shape, np.int32)
+        a1 = np.zeros(shape, np.int32)
+        a2 = np.zeros(shape, np.int32)
+        op[:, :L] = rga.OP_INSERT
+        key[:, :L] = (vs + js + t) % K
+        a0[:, :L] = rng.integers(32, 127, (R, L))
+        if t >= D:
+            op[:, L:] = rga.OP_DELETE
+            key[:, L:] = (vs + js + t - D) % K
+            a1[:, L:] = vs            # target writer = self
+            a2[:, L:] = t - D + 1     # converged counter of that tick
         return mbase.make_op_batch(
-            op=np.full(shape, rga.OP_INSERT, np.int32),
-            key=key,
-            a0=rng.integers(32, 127, shape),
-            writer=np.broadcast_to(
-                np.arange(R, dtype=np.int32)[:, None], shape).copy())
+            op=op, key=key, a0=a0, a1=a1, a2=a2,
+            writer=np.broadcast_to(vs, shape).copy())
 
-    batches = [jax.device_put(gen(i)) for i in range(4)]
     probe = jax.jit(lambda s: s["id_ctr"][0, 0, 0])
 
     def sync(s):
         return int(np.asarray(probe(s)))
 
-    state = tick(state, batches[0])
-    sync(state)  # compile barrier
+    # warmup/compile with the first batch shape (has no deletes yet)
+    state = tick(state, jax.device_put(gen(0)))
+    state = compact_all(state)
+    sync(state)
     t0 = time.perf_counter()
-    for i in range(1, cfg.ticks):
-        state = tick(state, batches[i % 4])
+    inserts = deletes = 0  # warmup tick excluded from the timed window
+    compactions = 0
+    for t in range(1, cfg.ticks):
+        state = tick(state, jax.device_put(gen(t)))
+        inserts += R * L
+        deletes += R * L if t >= D else 0
+        if t % C == C - 1:
+            state = compact_all(state)
+            compactions += 1
     sync(state)
     res.elapsed_s = time.perf_counter() - t0
-    res.total_ops = R * B * (cfg.ticks - 1)
+    res.total_ops = inserts + deletes
 
     doc0 = jax.tree.map(lambda x: x[0], state)
     text_fn = jax.jit(lambda s: rga.text(s, 0))
@@ -419,18 +491,28 @@ def run_rga_replay(cfg: BenchConfig) -> Results:
     out = text_fn(doc0)
     np.asarray(out["chr"])
     res.stats["get"].latencies_ms.append(1e3 * (time.perf_counter() - t1))
+    res.extra["applied_inserts"] = inserts + R * L  # incl. warmup tick
+    res.extra["applied_deletes"] = deletes
+    res.extra["compactions"] = compactions
     res.extra["elements_per_doc"] = int(
         np.asarray(rga.element_count(doc0))[0])
+    res.extra["live_per_doc"] = int(np.asarray(rga.length(doc0, 0)))
+    res.extra["slot_capacity"] = cap
     res.extra["depth_overflow"] = bool(np.asarray(out["overflow"]))
-    # capacity must never have truncated the union (silent element loss
-    # would invalidate every number above)
-    _, overflow = rga.merge_with_stats(
-        jax.tree.map(lambda x: x[0], state), jax.tree.map(lambda x: x[1], state))
-    res.extra["merge_overflow"] = int(np.asarray(overflow).sum())
-    expected = R * B * (cfg.ticks)
-    got = int(np.asarray(rga.element_count(doc0)).sum())
-    assert got == expected, (
-        f"replay lost elements: {got} != {expected} (capacity truncation)")
+    # convergence + accounting: all replicas bit-equal, and doc live
+    # counts match the trace exactly — the undeleted population is the
+    # last D ticks' inserts, so any capacity truncation (slot_union
+    # dropping elements) breaks this count and fails the run instead of
+    # silently faking the ops/s figure
+    for f in ("id_ctr", "id_rep", "dead", "valid"):
+        arr = np.asarray(state[f])
+        assert (arr[1:] == arr[:1]).all(), f"replicas diverged on {f}"
+    live_counts = (np.asarray(state["valid"]) & ~np.asarray(state["dead"])
+                   ).sum(-1)  # [R, K]
+    expect_live = ins_per_doc_tick * D
+    assert (live_counts == expect_live).all(), (
+        f"live counts {np.unique(live_counts)} != {expect_live}: "
+        "capacity truncated the replay (raise cap or compact more often)")
     # each counted op lands at EVERY replica (full convergence per tick);
     # the per-replica application rate is the reference-comparable number
     # (its ops/s also counts one application per replica-op)
@@ -443,12 +525,14 @@ PRESETS = {
     # BASELINE.json configs 1-4 (config 5, RGA, lives with the sequence type)
     "pnc": BenchConfig(name="pnc_4rep_banking_shape", type_code="pnc",
                        num_nodes=4, num_objects=100, ops_ratio=(0.2, 0.6, 0.2)),
-    # capacity sized to hold the run's full add volume (~4 adds/key/
-    # tick over ticks+warmup) — tombstones are never compacted mid-run,
-    # and silent slot overflow would fake healthy numbers
+    # capacity sized to live tags + one GC window of tombstones — the
+    # runtime compacts at every GC-frontier advance, so the per-key row
+    # stays small; a small row is also what keeps the batched-union
+    # record soup (state is re-sorted per delta apply) from dominating
+    # the tick
     "orset": BenchConfig(name="orset_16rep", type_code="orset", num_nodes=16,
                          window=8, num_objects=1000, ops_per_block=512,
-                         ticks=32, orset_capacity=256,
+                         ticks=32, orset_capacity=64, orset_rm_capacity=4,
                          ops_ratio=(0.0, 1.0, 0.0)),
     # 64-node two-type emulation: all 64 views' unions run on one chip,
     # so the tick is heavy — sized for a ~5-minute run
@@ -461,10 +545,27 @@ PRESETS = {
                              num_nodes=16, num_objects=500, ops_per_block=256,
                              byzantine=4, invalid_rate=0.25,
                              ops_ratio=(0.0, 0.8, 0.2)),
-    # BASELINE config 5: 1k replicas, ~1M-op collaborative-text replay
-    "rga": BenchConfig(name="rga_text_replay_1k", type_code="rga",
-                       num_nodes=1024, num_objects=64, ops_per_block=8,
-                       ticks=16),
+    # BASELINE config 5: 1k replicas, >=1M applied inserts (plus the
+    # matching deletes) with mid-run compaction — 1024 x 16 lanes x 64
+    # ticks = 1,048,576 inserts; live state stays ~bounded via the
+    # delete-lag/compaction churn
+    "rga": BenchConfig(name="rga_text_replay_1k_1M", type_code="rga",
+                       num_nodes=1024, num_objects=128, ops_per_block=32,
+                       ticks=64, rga_delete_lag=2, rga_compact_every=4),
+    # full client plane over loopback TCP (native server -> dispatch ->
+    # SafeKV), sized for a sustained-throughput reading vs the
+    # reference's 260k ops/s wire peak
+    "wire": BenchConfig(name="wire_pnc", type_code="pnc", mode="wire",
+                        num_nodes=4, num_objects=100, ops_per_block=2048,
+                        clients=16, ops_per_client=3000, pipeline=256,
+                        ops_ratio=(0.3, 0.6, 0.1)),
+    # crash-fault pair (paper §6.2 Fig 11: 8 nodes, 0 vs 2 crashed)
+    "pnc8": BenchConfig(name="pnc_8rep_baseline", type_code="pnc",
+                        num_nodes=8, num_objects=100, ops_per_block=1000,
+                        ticks=60, ops_ratio=(0.2, 0.6, 0.2)),
+    "crash": BenchConfig(name="pnc_8rep_2crashed", type_code="pnc",
+                         num_nodes=8, num_objects=100, ops_per_block=1000,
+                         ticks=60, crashed=2, ops_ratio=(0.2, 0.6, 0.2)),
 }
 
 

@@ -22,23 +22,37 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--presets", nargs="*", default=["pnc"])
     ap.add_argument("--banking", action="store_true")
+    ap.add_argument("--banking-wan", action="store_true",
+                    help="banking under emulated 50+/-10 ms WAN "
+                         "(paper §6.3 Fig 12 configuration)")
     ap.add_argument("--out", default="results.jsonl")
     args = ap.parse_args()
 
+    import time
+
     from janus_tpu.bench.harness import PRESETS, run
+
+    def emit(f, name, payload):
+        payload = {"run": name, "ts": round(time.time(), 1), **payload}
+        line = json.dumps(payload)
+        print(line, flush=True)
+        f.write(line + "\n")
+        f.flush()
 
     with open(args.out, "a") as f:
         for name in args.presets:
             res = run(PRESETS[name])
-            line = json.dumps(res.to_dict())
-            print(line)
-            f.write(line + "\n")
-        if args.banking:
+            emit(f, name, res.to_dict())
+        if args.banking or args.banking_wan:
+            import dataclasses as dc
+
             from janus_tpu.bench.banking import BankingConfig, run_banking
-            res = run_banking(BankingConfig())
-            line = json.dumps(res.to_dict())
-            print(line)
-            f.write(line + "\n")
+            if args.banking:
+                emit(f, "banking", run_banking(BankingConfig()).to_dict())
+            if args.banking_wan:
+                cfg = dc.replace(BankingConfig(), wan_delay_ms=50.0,
+                                 wan_jitter_ms=10.0)
+                emit(f, "banking_wan", run_banking(cfg).to_dict())
 
 
 if __name__ == "__main__":

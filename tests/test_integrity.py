@@ -108,3 +108,35 @@ def test_committee_key_table():
     assert set(com.keys) == {0, 1, 2, 3}
     # distinct identities
     assert len({r.pub for r in com.replicas}) == 4
+
+
+def test_no_fetch_mirror_matches_fetch_mode():
+    """The host-side lockstep mirror (zero extra device fetches) must
+    drive the plane to EXACTLY the same digests/signing/pruning as the
+    fetch-mode path — same Byzantine injection, same commit outcomes
+    (VERDICT round-3 item 6)."""
+    rng_a = np.random.default_rng(11)
+    rng_b = np.random.default_rng(11)
+    byz = np.asarray([False, False, False, True])
+
+    def build(no_fetch):
+        cfg = DagConfig(N, W)
+        kv = SafeKV(cfg, pncounter.SPEC, ops_per_block=B,
+                    num_keys=K, num_writers=N)
+        plane = IntegrityPlane(cfg, byzantine=byz, invalid_rate=0.5, seed=3)
+        return SecureCluster(kv, plane, no_fetch=no_fetch)
+
+    fast, slow = build(True), build(False)
+    for _ in range(4 * W):
+        fast.step(pnc_ops(rng_a))
+        slow.step(pnc_ops(rng_b))
+    assert fast.plane.pruned_blocks() == slow.plane.pruned_blocks()
+    assert fast.plane.verified_bad == slow.plane.verified_bad > 0
+    for f in fast.kv.dag:
+        np.testing.assert_array_equal(
+            np.asarray(fast.kv.dag[f]), np.asarray(slow.kv.dag[f]),
+            err_msg=f)
+    stable_f = np.asarray(fast.kv.query_stable("get"))
+    stable_s = np.asarray(slow.kv.query_stable("get"))
+    np.testing.assert_array_equal(stable_f, stable_s)
+    assert fast.kv.ordered_commits(0) == slow.kv.ordered_commits(0)
