@@ -142,7 +142,7 @@ def consensus_bench() -> dict:
     with ThreadPoolExecutor(max_workers=8) as pool:
         # warmup: compile + reach GC steady state (>= 2 windows of
         # rounds at any FUSE)
-        run(pool, max(2, (2 * CW) // FUSE), PIPELINE)
+        run(pool, max(2, (2 * CW + FUSE - 1) // FUSE), PIPELINE)
         n_warm_lat = len(kv.latency_log)
         # throughput phase: deep pipeline saturates the device
         dt = run(pool, n_disp, PIPELINE)

@@ -98,6 +98,14 @@ class CRDTTypeSpec:
     # rm_capacity -> capacity)
     dim_defaults: Dict[str, str] = dataclasses.field(default_factory=dict)
     prepare_ops: Callable[[Any, OpBatch], OpBatch] | None = None
+    # Batched exact capture: semantically identical to scanning
+    # prepare_ops+apply per op (each op observes the pre-batch state
+    # PLUS earlier lanes of its own batch), but computed as one tensor
+    # program — a B-deep sequential lax.scan of tiny row ops is
+    # latency-bound on TPU and dominates the submit path. When set,
+    # capture_and_apply uses this and applies the whole prepared batch
+    # at once (apply_ops must accept captured batches).
+    prepare_ops_batch: Callable[[Any, OpBatch], OpBatch] | None = None
     # Replay safety: True iff apply_ops is a pure function of (state, op
     # data) whose replicated replay converges under any certify/commit
     # batching — either because apply is order-insensitive with no reads
@@ -133,6 +141,9 @@ def capture_and_apply(spec: CRDTTypeSpec, state: Any, ops: OpBatch):
     local state, so per-op interleaving is irrelevant)."""
     from jax import lax as _lax
 
+    if spec.prepare_ops_batch is not None:
+        prepared = spec.prepare_ops_batch(state, ops)
+        return spec.apply_ops(state, prepared), prepared
     if spec.prepare_ops is None:
         return spec.apply_ops(state, ops), ops
 

@@ -108,6 +108,64 @@ def prepare_ops(state: State, ops: base.OpBatch) -> base.OpBatch:
     }
 
 
+def prepare_ops_batch(state: State, ops: base.OpBatch) -> base.OpBatch:
+    """Exact batched form of ``prepare_ops`` + intra-batch visibility:
+    one tensor program instead of a B-deep sequential capture scan.
+
+    A remove/clear at lane i observes (a) the pre-batch state's matching
+    tags and (b) matching tags minted by ADD lanes j < i of the same
+    batch and key — precisely what the sequential capture_and_apply scan
+    observes (earlier removes only tombstone, never un-observe, so they
+    cannot change a later capture's selection; capacity eviction of a
+    same-batch add is the one divergence, and it only over-captures an
+    already-dead tag, which the union fold ignores)."""
+    b = ops["op"].shape[0]
+    keys = ops["key"]
+    rows_valid = state["valid"][keys]          # [B, C]
+    rows_elem = state["elem"][keys]
+    rows_rep = state["tag_rep"][keys]
+    rows_ctr = state["tag_ctr"][keys]
+    is_rm = ops["op"] == OP_REMOVE
+    is_cl = ops["op"] == OP_CLEAR
+    is_tomb = is_rm | is_cl
+    sel_state = (rows_valid & is_tomb[:, None]
+                 & jnp.where(is_rm[:, None],
+                             rows_elem == ops["a0"][:, None], True))
+    lanes = jnp.arange(b)
+    is_add = ops["op"] == OP_ADD
+    sel_batch = ((lanes[None, :] < lanes[:, None])
+                 & is_add[None, :]
+                 & (keys[None, :] == keys[:, None])
+                 & is_tomb[:, None]
+                 & jnp.where(is_rm[:, None],
+                             ops["a0"][None, :] == ops["a0"][:, None],
+                             True))                       # [B(i), B(j)]
+    badd = jnp.broadcast_to
+    cand_rep = jnp.concatenate([
+        jnp.where(sel_state, rows_rep, SENTINEL),
+        jnp.where(sel_batch, badd(ops["a1"][None, :], (b, b)), SENTINEL),
+    ], axis=1)
+    cand_ctr = jnp.concatenate([
+        jnp.where(sel_state, rows_ctr, SENTINEL),
+        jnp.where(sel_batch, badd(ops["a2"][None, :], (b, b)), SENTINEL),
+    ], axis=1)
+    cand_elem = jnp.concatenate([
+        jnp.where(sel_state, rows_elem, 0),
+        jnp.where(sel_batch, badd(ops["a0"][None, :], (b, b)), 0),
+    ], axis=1)
+    # canonical tag order, unselected (SENTINEL) last — same layout the
+    # sequential capture emits — then slice to the capture width
+    r_cap = state["_rm_cap"].shape[-2]
+    srt = lax.sort((cand_rep, cand_ctr, cand_elem), dimension=-1,
+                   num_keys=2, is_stable=True)
+    return {
+        **ops,
+        "rm_rep": srt[0][..., :r_cap],
+        "rm_ctr": srt[1][..., :r_cap],
+        "rm_elem": srt[2][..., :r_cap],
+    }
+
+
 def _canonical_row(row):
     """Sort one [C] row by tag (invalid slots last, SENTINEL keys, zero
     payloads) — the same layout slot_union emits. Every apply path keeps
@@ -168,18 +226,14 @@ def _apply_captured_batch(state: State, ops: base.OpBatch) -> State:
     key = jnp.where(valid, key, K)
     rep = jnp.where(valid, rep, SENTINEL)
     ctr = jnp.where(valid, ctr, SENTINEL)
-    # argsort by (key, rep, ctr) as three stable single-key passes,
-    # least-significant key first (LSD radix over stable sorts) — a
-    # multi-operand multi-key lax.sort compiles ~5x slower on TPU for
-    # the same runtime, and int64 key packing is unavailable (JAX
-    # canonicalizes int64 to int32 without x64)
-    idx = jnp.arange(T, dtype=jnp.int32)
-    _, idx = lax.sort((ctr, idx), dimension=-1, num_keys=1, is_stable=True)
-    _, idx = lax.sort((rep[idx], idx), dimension=-1, num_keys=1,
-                      is_stable=True)
-    _, idx = lax.sort((key[idx], idx), dimension=-1, num_keys=1,
-                      is_stable=True)
-    key, rep, ctr = key[idx], rep[idx], ctr[idx]
+    # argsort by (key, rep, ctr): one multi-key sort (measured FASTER at
+    # runtime than the LSD radix of stable passes on TPU — 317 ms vs
+    # 406 ms at T=534k x16 views; int64 key packing is unavailable
+    # since JAX canonicalizes int64 to int32 without x64)
+    idx0 = jnp.arange(T, dtype=jnp.int32)
+    srt0 = lax.sort((key, rep, ctr, idx0), dimension=-1, num_keys=3,
+                    is_stable=True)
+    key, rep, ctr, idx = srt0
     valid, elem, rm = valid[idx], elem[idx], rm[idx] & valid[idx]
 
     # segment-fold duplicate tags (a tag can appear 3+ times: state +
@@ -215,28 +269,30 @@ def _apply_captured_batch(state: State, ops: base.OpBatch) -> State:
     rank = excl - excl[last_kfirst]
     ok = keep & (rank < C) & (key < K)
 
-    # ONE unique-index scatter of packed records: duplicate dump cells
-    # would serialize the scatter, and five separate scatters pay the
-    # index cost five times. flags word: bit0 removed, bit1 valid.
-    d = jnp.where(ok, key * C + rank, K * C + jnp.arange(T, dtype=jnp.int32))
-    packed = jnp.stack([
-        jnp.where(ok, rep, SENTINEL),
-        jnp.where(ok, ctr, SENTINEL),
-        jnp.where(ok, elem, 0),
-        (ok & rm_k).astype(jnp.int32) + 2 * ok.astype(jnp.int32),
-    ], axis=-1)  # [T, 4]
-    buf = jnp.concatenate([
-        jnp.tile(jnp.asarray([SENTINEL, SENTINEL, 0, 0], jnp.int32),
-                 (K * C, 1)),
-        jnp.zeros((T, 4), jnp.int32),
-    ])
-    buf = buf.at[d].set(packed)[: K * C].reshape(K, C, 4)
+    # Placement WITHOUT a scatter: a T-sized arbitrary-index scatter
+    # serializes on TPU (measured 1.4 s of a 1.8 s apply at T=534k x16
+    # views). Instead: one stable single-key sort compacts kept records
+    # to the front IN (key, tag) ORDER (dropped records canonicalize to
+    # key=K and sink), then each output row gathers its contiguous
+    # span, located by binary search over the compacted key channel.
+    key_c = jnp.where(ok, key, K)
+    comp = lax.sort(
+        (key_c, rep, ctr, elem, (ok & rm_k).astype(jnp.int32)),
+        dimension=-1, num_keys=1, is_stable=True)
+    ckey, crep, cctr, celem, crm = comp
+    lo = jnp.searchsorted(ckey, jnp.arange(K, dtype=jnp.int32),
+                          side="left")
+    hi = jnp.searchsorted(ckey, jnp.arange(K, dtype=jnp.int32),
+                          side="right")
+    pos = lo[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]  # [K, C]
+    out_valid = pos < hi[:, None]  # kept-per-key <= C by the ok cap
+    pos = jnp.clip(pos, 0, T - 1)
     return {
-        "tag_rep": buf[..., 0],
-        "tag_ctr": buf[..., 1],
-        "elem": buf[..., 2],
-        "removed": (buf[..., 3] & 1).astype(bool),
-        "valid": (buf[..., 3] >= 2),
+        "tag_rep": jnp.where(out_valid, crep[pos], SENTINEL),
+        "tag_ctr": jnp.where(out_valid, cctr[pos], SENTINEL),
+        "elem": jnp.where(out_valid, celem[pos], 0),
+        "removed": out_valid & (crm[pos] > 0),
+        "valid": out_valid,
         "_rm_cap": state["_rm_cap"],
     }
 
@@ -449,6 +505,7 @@ SPEC = base.register_type(
                    "rm_elem": "rm_capacity"},
         dim_defaults={"rm_capacity": "capacity"},
         prepare_ops=prepare_ops,
+        prepare_ops_batch=prepare_ops_batch,
         compact_fence=compact_fence,
     )
 )

@@ -241,3 +241,49 @@ def test_orset_batched_replay_matches_scan_path():
     for f in ("tag_rep", "tag_ctr", "elem", "removed", "valid"):
         np.testing.assert_array_equal(np.asarray(st_half[f]),
                                       np.asarray(st_batch[f]), err_msg=f)
+
+
+def test_orset_batched_capture_matches_sequential_scan():
+    """prepare_ops_batch must be semantically exact vs the sequential
+    per-op capture scan it replaces: identical POST-STATE always, and
+    identical captured payloads while rows stay below capacity (at
+    capacity the batched path may additionally capture a tag the scan
+    saw evicted — documented, and dead-on-arrival in the union fold)."""
+    import dataclasses
+
+    import numpy as np
+
+    from janus_tpu.models import base, orset
+
+    seq_spec = dataclasses.replace(orset.SPEC, prepare_ops_batch=None,
+                                   type_code="orset_seqtest")
+    rng = np.random.default_rng(21)
+    for trial in range(6):
+        st_a = orset.init(num_keys=4, capacity=32, rm_capacity=8)
+        st_b = orset.init(num_keys=4, capacity=32, rm_capacity=8)
+        ctr = 0
+        for _round in range(3):
+            b = 24
+            ops_np = {
+                "op": rng.integers(orset.OP_ADD, orset.OP_CLEAR + 1, b),
+                "key": rng.integers(0, 4, b),
+                "a0": rng.integers(0, 5, b),
+                "a1": rng.integers(0, 3, b),
+                "a2": np.arange(ctr, ctr + b),
+                "writer": np.zeros(b, np.int64),
+            }
+            ctr += b
+            ops = base.make_op_batch(**{k: v.astype(np.int32)
+                                        for k, v in ops_np.items()})
+            st_a, prep_a = base.capture_and_apply(orset.SPEC, st_a, ops)
+            st_b, prep_b = base.capture_and_apply(seq_spec, st_b, ops)
+            for f in ("rm_rep", "rm_ctr", "rm_elem"):
+                np.testing.assert_array_equal(
+                    np.asarray(prep_a[f]), np.asarray(prep_b[f]),
+                    err_msg=f"trial {trial} payload {f}")
+            for f in st_a:
+                if f == "_rm_cap":
+                    continue
+                np.testing.assert_array_equal(
+                    np.asarray(st_a[f]), np.asarray(st_b[f]),
+                    err_msg=f"trial {trial} state {f}")
