@@ -581,6 +581,16 @@ def run(cfg: BenchConfig) -> Results:
 
 def main(argv: Optional[List[str]] = None) -> None:
     import argparse
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # honor a co-located-host request even where a site hook
+        # force-registers a tunneled device platform (the wire plane's
+        # deployment shape is service-next-to-chip; driving it through
+        # a ~100 ms tunnel RTT per step measures the tunnel, not the
+        # framework — see tests/conftest.py for the same pin)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", help="JSON BenchConfig file")

@@ -262,6 +262,11 @@ class JanusService:
         # read-your-writes gate is O(1) per deferred read instead of a
         # walk of every pending queue item per read per step
         self._conn_pending: Dict[int, int] = {}
+        # per-step read cache: whole-table query results fetched ONCE
+        # and answered for every read of that shape — an un-jitted
+        # vmapped device query per read (~ms each) otherwise dominates
+        # the step under read-heavy load
+        self._read_cache: Dict[Tuple, np.ndarray] = {}
         # replies accumulate during a step and flush as ONE native call
         # (one TCP send per distinct connection, reply_batch)
         self._reply_buf: List[Tuple[int, str, str]] = []
@@ -467,6 +472,7 @@ class JanusService:
         # 'success' reply); unready reads retry next step
         queue = self._deferred_reads + reads
         self._deferred_reads = []
+        self._read_cache.clear()  # state advanced this step
         for it in queue:
             rt = self.types[it["tid"]]
             home = self._homes[(it["tag"] >> 32) % len(self._homes)]
@@ -763,14 +769,23 @@ class JanusService:
         prosp = letters in ("gp", "sp")
         q = rt.kv.query_prospective if prosp else rt.kv.query_stable
         code = rt.spec.type_code
+
+        def table(name: str) -> np.ndarray:
+            # whole-table queries are fetched once per step and shared
+            # by every read of that shape
+            ck = (id(rt), name, prosp)
+            got = self._read_cache.get(ck)
+            if got is None:
+                got = np.asarray(q(name))
+                self._read_cache[ck] = got
+            return got
+
         if code == "pnc":
-            vals = np.asarray(q("get"))  # [N, K]
-            return str(int(vals[home, slot]))
+            return str(int(table("get")[home, slot]))
         if code in ("orset", "lww", "tpset", "mvr"):
             if letters in ("sp", "ss"):
                 sizeq = "num_values" if code == "mvr" else "live_count"
-                got = np.asarray(q(sizeq))  # [N, K]
-                return str(int(got[home, slot]))
+                return str(int(table(sizeq)[home, slot]))
             memq = "has_value" if code == "mvr" else "contains"
             got = np.asarray(q(memq, slot, self._elem_id(it["p0"])))  # [N]
             return "true" if bool(got[home]) else "false"
