@@ -34,7 +34,12 @@ import numpy as np
 R = int(os.environ.get("BENCH_REPLICAS", 256))
 K = int(os.environ.get("BENCH_KEYS", 1024))
 B = int(os.environ.get("BENCH_OPS_PER_REPLICA", 1024))
-TICKS = int(os.environ.get("BENCH_TICKS", 20))
+# 80 ticks: the timed window ends with ONE tunneled sync fetch (~100 ms
+# on the relay backend); at 20 ticks that fetch was ~30% of the
+# denominator and moved the headline by whole M-ops/s between rounds
+# (r3 14.2M -> r4 11.2M with no engine change). More ticks amortize it;
+# the sync share is also reported so the isolation is visible.
+TICKS = int(os.environ.get("BENCH_TICKS", 80))
 # consensus-path geometry: reference default config is 4 nodes / 100
 # objects (paper §6.1); blocks of 4000 ops saturate the chip while
 # holding commit lag at 3-4 rounds (1000 matches the reference peak
@@ -96,14 +101,10 @@ def consensus_bench() -> dict:
         op=np.zeros((FUSE, CN, CB), np.int32)))
     safe_k = np.ones((FUSE, CN, CB), bool)
 
+    from janus_tpu.utils.perf import backend_rtt
+
     # measure backend sync round-trip (the observation-latency floor)
-    probe = jax.jit(lambda x: x + 1)
-    x = probe(np.zeros((4,), np.int32))
-    np.asarray(x)
-    t0 = time.perf_counter()
-    for _ in range(5):
-        np.asarray(probe(x))
-    rtt = (time.perf_counter() - t0) / 5
+    rtt = backend_rtt()
 
     def fetch(packed):
         arr = np.asarray(packed)
@@ -172,6 +173,79 @@ def consensus_bench() -> dict:
         "tick_ms": round(tick_ms, 3),
         "commit_lag_ticks_p50": int(np.percentile(lag_ticks, 50)),
         "commit_lag_ticks_p99": int(np.percentile(lag_ticks, 99)),
+    }
+
+
+def chip_latency_decomposition() -> dict:
+    """Chip-side op->commit decomposition at the LATENCY geometry (B=512,
+    one round per dispatch, depth-2 shape): the tunnel makes a co-located
+    wall-clock measurement on the chip impossible here, so this measures
+    the two tunnel-free components separately — per-round device time
+    (deep dispatch queue, one sync: tick_ms) and the commit-lag
+    distribution in TICKS (computed from tick indices, immune to fetch
+    latency) — and reports their product as the derived co-located-chip
+    percentile next to the raw tunneled wall clock and the RTT
+    (round-4 verdict item 6). Reference ack point:
+    ClientInterface.cs:186-190."""
+    import jax
+
+    from janus_tpu.consensus import DagConfig
+    from janus_tpu.models import base, pncounter
+    from janus_tpu.runtime.safecrdt import SafeKV
+
+    from janus_tpu.bench.workloads import pnc_uniform
+
+    lb = int(os.environ.get("BENCH_LAT_OPS_PER_BLOCK", 512))
+    ticks = int(os.environ.get("BENCH_LAT_TICKS", 96))
+    rng = np.random.default_rng(3)
+    kv = SafeKV(DagConfig(CN, CW), pncounter.SPEC, ops_per_block=lb,
+                collect_logs=False, num_keys=CK, num_writers=CN)
+    from janus_tpu.utils.perf import backend_rtt
+
+    batches = [jax.device_put(pnc_uniform(rng, CN, CK, lb))
+               for _ in range(3)]
+    safe = np.ones((CN, lb), bool)
+    rtt = backend_rtt()
+
+    # warmup to GC steady state, absorbing as we go
+    pend = []
+    for i in range(2 * CW + 4):
+        pend.append(kv.step_dispatch(batches[i % 3], safe=safe))
+    for packed, meta in pend:
+        kv.step_absorb(packed, meta)
+    kv.latency_log.clear()
+    # timed phase: dispatch every round back-to-back, ONE sync at the
+    # end — tick_ms is device time per protocol round at this geometry
+    pend = []
+    t0 = time.perf_counter()
+    for i in range(ticks):
+        pend.append(kv.step_dispatch(batches[i % 3], safe=safe))
+    last = np.asarray(pend[-1][0])  # sync barrier (one tunneled fetch)
+    dt = time.perf_counter() - t0
+    for j, (packed, meta) in enumerate(pend):
+        kv.step_absorb(last if j == len(pend) - 1 else packed, meta)
+    # drain so every timed block's commit lag is recorded
+    idle = jax.device_put(base.make_op_batch(
+        op=np.zeros((CN, lb), np.int32)))
+    for _ in range(2 * CW):
+        packed, meta = kv.step_dispatch(idle, record=False)
+        kv.step_absorb(packed, meta)
+    tick_ms = max(1e3 * (dt - rtt) / ticks, 0.0)
+    lag = np.asarray(kv.latency_log)
+    lag50 = float(np.percentile(lag, 50))
+    lag99 = float(np.percentile(lag, 99))
+    return {
+        "ops_per_block": lb,
+        "rounds_per_dispatch": 1,
+        "tick_ms": round(tick_ms, 3),
+        "commit_lag_ticks_p50": lag50,
+        "commit_lag_ticks_p99": lag99,
+        "derived_chip_p50_ms": round(lag50 * tick_ms, 3),
+        "derived_chip_p99_ms": round(lag99 * tick_ms, 3),
+        "backend_rtt_ms": round(1e3 * rtt, 2),
+        "note": ("derived = measured tick_ms x measured commit-lag "
+                 "ticks at the latency geometry; tunnel RTT excluded "
+                 "from both factors"),
     }
 
 
@@ -251,6 +325,12 @@ def main() -> None:
     state = tick(state, ops[0])
     sync(state)
 
+    # sync-fetch floor (the tunneled readback that closes the timed
+    # window): measured so its share of the denominator is explicit
+    t0 = time.perf_counter()
+    sync(state)
+    rtt = time.perf_counter() - t0
+
     t0 = time.perf_counter()
     for i in range(TICKS):
         state = tick(state, ops[i % len(ops)])
@@ -263,7 +343,15 @@ def main() -> None:
         "value": round(ops_per_sec, 1),
         "unit": "ops/s",
         "vs_baseline": round(ops_per_sec / BASELINE_OPS_PER_SEC, 2),
+        "fastpath_isolation": {
+            "ticks": TICKS,
+            "sync_rtt_ms": round(1e3 * rtt, 2),
+            "sync_share_of_window": round(rtt / dt, 4),
+            "ops_per_sec_rtt_excluded": round(
+                R * B * TICKS / max(dt - rtt, 1e-9), 1),
+        },
         "consensus": consensus_bench(),
+        "chip_latency_decomposition": chip_latency_decomposition(),
         "consensus_colocated": consensus_colocated(),
     }))
 

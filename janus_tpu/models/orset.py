@@ -118,9 +118,19 @@ def prepare_ops_batch(state: State, ops: base.OpBatch) -> base.OpBatch:
     observes (earlier removes only tombstone, never un-observe, so they
     cannot change a later capture's selection; capacity eviction of a
     same-batch add is the one divergence, and it only over-captures an
-    already-dead tag, which the union fold ignores)."""
+    already-dead tag, which the union fold ignores).
+
+    Staged so nothing B-wide is ever SORTED (a [B, C+B] candidate sort
+    measured 356 ms/tick at B=2048 x16 views): the capture keeps at most
+    r_cap tags, so each source is reduced to its first r_cap candidates
+    in tag order first — the state rows via a [B, C] compaction sort
+    (rows are canonical, so tag order is row order), the batch adds via
+    one [B] tag sort plus rank selection over the [B, B] mask — and only
+    the [B, 2*r_cap] union is tag-sorted and sliced. first-r_cap(union)
+    == first-r_cap(first-r_cap(A) u first-r_cap(B)) keeps it exact."""
     b = ops["op"].shape[0]
     keys = ops["key"]
+    r_cap = state["_rm_cap"].shape[-2]
     rows_valid = state["valid"][keys]          # [B, C]
     rows_elem = state["elem"][keys]
     rows_rep = state["tag_rep"][keys]
@@ -128,41 +138,62 @@ def prepare_ops_batch(state: State, ops: base.OpBatch) -> base.OpBatch:
     is_rm = ops["op"] == OP_REMOVE
     is_cl = ops["op"] == OP_CLEAR
     is_tomb = is_rm | is_cl
+
+    # stage 1: state capture — selected tags first, in tag (= row) order
     sel_state = (rows_valid & is_tomb[:, None]
                  & jnp.where(is_rm[:, None],
                              rows_elem == ops["a0"][:, None], True))
-    lanes = jnp.arange(b)
+    srt = lax.sort(((~sel_state).astype(jnp.int32),
+                    jnp.where(sel_state, rows_rep, SENTINEL),
+                    jnp.where(sel_state, rows_ctr, SENTINEL),
+                    jnp.where(sel_state, rows_elem, 0)),
+                   dimension=-1, num_keys=1, is_stable=True)
+    st_rep, st_ctr, st_elem = (srt[1][..., :r_cap], srt[2][..., :r_cap],
+                               srt[3][..., :r_cap])
+
+    # stage 2: batch-add capture — order the adds by tag ONCE (lane
+    # order already equals tag order for minted tags, but the sort makes
+    # it exact for arbitrary a1/a2), then pick each row's first r_cap
+    # matching adds by rank, no B-wide sort
+    lanes = jnp.arange(b, dtype=jnp.int32)
     is_add = ops["op"] == OP_ADD
-    sel_batch = ((lanes[None, :] < lanes[:, None])
-                 & is_add[None, :]
-                 & (keys[None, :] == keys[:, None])
-                 & is_tomb[:, None]
-                 & jnp.where(is_rm[:, None],
-                             ops["a0"][None, :] == ops["a0"][:, None],
-                             True))                       # [B(i), B(j)]
-    badd = jnp.broadcast_to
-    cand_rep = jnp.concatenate([
-        jnp.where(sel_state, rows_rep, SENTINEL),
-        jnp.where(sel_batch, badd(ops["a1"][None, :], (b, b)), SENTINEL),
-    ], axis=1)
-    cand_ctr = jnp.concatenate([
-        jnp.where(sel_state, rows_ctr, SENTINEL),
-        jnp.where(sel_batch, badd(ops["a2"][None, :], (b, b)), SENTINEL),
-    ], axis=1)
-    cand_elem = jnp.concatenate([
-        jnp.where(sel_state, rows_elem, 0),
-        jnp.where(sel_batch, badd(ops["a0"][None, :], (b, b)), 0),
-    ], axis=1)
-    # canonical tag order, unselected (SENTINEL) last — same layout the
-    # sequential capture emits — then slice to the capture width
-    r_cap = state["_rm_cap"].shape[-2]
-    srt = lax.sort((cand_rep, cand_ctr, cand_elem), dimension=-1,
-                   num_keys=2, is_stable=True)
+    s_rep, s_ctr, s_lane, s_key, s_a0 = lax.sort(
+        (jnp.where(is_add, ops["a1"], SENTINEL),
+         jnp.where(is_add, ops["a2"], SENTINEL),
+         lanes, keys, ops["a0"]),
+        dimension=-1, num_keys=2, is_stable=True)
+    s_valid = s_rep != SENTINEL
+    mask = (s_valid[None, :]
+            & (s_lane[None, :] < lanes[:, None])
+            & (s_key[None, :] == keys[:, None])
+            & is_tomb[:, None]
+            & jnp.where(is_rm[:, None],
+                        s_a0[None, :] == ops["a0"][:, None],
+                        True))                        # [B(i), B(sorted j)]
+    rank = jnp.cumsum(mask, axis=1) - 1
+    ba = []
+    for r in range(r_cap):
+        hit = mask & (rank == r)
+        has = jnp.any(hit, axis=1)
+        take = jnp.argmax(hit, axis=1)
+        ba.append((jnp.where(has, s_rep[take], SENTINEL),
+                   jnp.where(has, s_ctr[take], SENTINEL),
+                   jnp.where(has, s_a0[take], 0)))
+    ba_rep = jnp.stack([x[0] for x in ba], axis=1)    # [B, r_cap]
+    ba_ctr = jnp.stack([x[1] for x in ba], axis=1)
+    ba_elem = jnp.stack([x[2] for x in ba], axis=1)
+
+    # stage 3: union of the two r_cap prefixes, tag-sorted, sliced
+    m_rep = jnp.concatenate([st_rep, ba_rep], axis=1)
+    m_ctr = jnp.concatenate([st_ctr, ba_ctr], axis=1)
+    m_elem = jnp.concatenate([st_elem, ba_elem], axis=1)
+    srt3 = lax.sort((m_rep, m_ctr, m_elem), dimension=-1, num_keys=2,
+                    is_stable=True)
     return {
         **ops,
-        "rm_rep": srt[0][..., :r_cap],
-        "rm_ctr": srt[1][..., :r_cap],
-        "rm_elem": srt[2][..., :r_cap],
+        "rm_rep": srt3[0][..., :r_cap],
+        "rm_ctr": srt3[1][..., :r_cap],
+        "rm_elem": srt3[2][..., :r_cap],
     }
 
 
@@ -204,37 +235,53 @@ def _apply_captured_batch(state: State, ops: base.OpBatch) -> State:
     is_add = en & (ops["op"] == OP_ADD)
     is_tomb = en & ((ops["op"] == OP_REMOVE) | (ops["op"] == OP_CLEAR))
 
+    # Op records SHARE lanes: an op is either an add (one record, lane 0
+    # of its rm lanes, which adds never use) or a remove/clear (<= R
+    # captured tombstones) — B*R lanes instead of B*(1+R). The sort is
+    # the tick's dominant cost, and it scales with lane count, not with
+    # how many lanes are valid.
+    lane0 = jnp.zeros((B, R), bool).at[:, 0].set(True)
+    add_l = is_add[:, None] & lane0
+    tomb_l = is_tomb[:, None] & (ops["rm_rep"] != SENTINEL)
+    op_valid = add_l | tomb_l
+    op_rep = jnp.where(add_l, ops["a1"][:, None], ops["rm_rep"])
+    op_ctr = jnp.where(add_l, ops["a2"][:, None], ops["rm_ctr"])
+    op_elem = jnp.where(add_l, ops["a0"][:, None], ops["rm_elem"])
+
     # record soup: (key, rep, ctr, elem, removed, valid)
     st_key = jnp.broadcast_to(jnp.arange(K)[:, None], (K, C)).reshape(-1)
     key = jnp.concatenate([
-        st_key, ops["key"],
+        st_key,
         jnp.broadcast_to(ops["key"][:, None], (B, R)).reshape(-1)])
-    rep = jnp.concatenate([state["tag_rep"].reshape(-1), ops["a1"],
-                           ops["rm_rep"].reshape(-1)])
-    ctr = jnp.concatenate([state["tag_ctr"].reshape(-1), ops["a2"],
-                           ops["rm_ctr"].reshape(-1)])
-    elem = jnp.concatenate([state["elem"].reshape(-1), ops["a0"],
-                            ops["rm_elem"].reshape(-1)])
+    rep = jnp.concatenate([state["tag_rep"].reshape(-1),
+                           op_rep.reshape(-1)])
+    ctr = jnp.concatenate([state["tag_ctr"].reshape(-1),
+                           op_ctr.reshape(-1)])
+    elem = jnp.concatenate([state["elem"].reshape(-1),
+                            op_elem.reshape(-1)])
     rm = jnp.concatenate([state["removed"].reshape(-1),
-                          jnp.zeros((B,), bool), jnp.ones((B * R,), bool)])
-    valid = jnp.concatenate([
-        state["valid"].reshape(-1), is_add,
-        ((ops["rm_rep"] != SENTINEL) & is_tomb[:, None]).reshape(-1)])
+                          tomb_l.reshape(-1)])
+    valid = jnp.concatenate([state["valid"].reshape(-1),
+                             op_valid.reshape(-1)])
     T = key.shape[0]
 
-    # canonicalize invalid records to sort last
+    # canonicalize invalid records to sort last; key >= K marks invalid
+    # from here on (st_key and client keys are < K, so validity rides
+    # the sort for free instead of as a carried operand)
     key = jnp.where(valid, key, K)
     rep = jnp.where(valid, rep, SENTINEL)
     ctr = jnp.where(valid, ctr, SENTINEL)
-    # argsort by (key, rep, ctr): one multi-key sort (measured FASTER at
-    # runtime than the LSD radix of stable passes on TPU — 317 ms vs
-    # 406 ms at T=534k x16 views; int64 key packing is unavailable
-    # since JAX canonicalizes int64 to int32 without x64)
-    idx0 = jnp.arange(T, dtype=jnp.int32)
-    srt0 = lax.sort((key, rep, ctr, idx0), dimension=-1, num_keys=3,
+    # ONE multi-key sort carrying the payloads as extra operands:
+    # measured FASTER than LSD radix passes (317 vs 406 ms at T=534k
+    # x16 views) AND than sort-a-permutation-then-gather — an arbitrary
+    # T-sized gather costs as much as the sort itself on TPU (147 ms vs
+    # 131 ms at T=228k x16), so payloads ride the sort instead. int64
+    # key packing is unavailable (JAX canonicalizes int64 to int32
+    # without x64).
+    srt0 = lax.sort((key, rep, ctr, elem, rm), dimension=-1, num_keys=3,
                     is_stable=True)
-    key, rep, ctr, idx = srt0
-    valid, elem, rm = valid[idx], elem[idx], rm[idx] & valid[idx]
+    key, rep, ctr, elem, rm = srt0
+    valid = key < K
 
     # segment-fold duplicate tags (a tag can appear 3+ times: state +
     # add + several captured removes). All copies of a tag carry the
@@ -267,7 +314,7 @@ def _apply_captured_batch(state: State, ops: base.OpBatch) -> State:
     key_first = jnp.ones((T,), bool).at[1:].set(key[1:] != key[:-1])
     last_kfirst = lax.cummax(jnp.where(key_first, idx_arr, 0))
     rank = excl - excl[last_kfirst]
-    ok = keep & (rank < C) & (key < K)
+    ok = keep & (rank < C)
 
     # Placement WITHOUT a scatter: a T-sized arbitrary-index scatter
     # serializes on TPU (measured 1.4 s of a 1.8 s apply at T=534k x16
@@ -275,10 +322,11 @@ def _apply_captured_batch(state: State, ops: base.OpBatch) -> State:
     # to the front IN (key, tag) ORDER (dropped records canonicalize to
     # key=K and sink), then each output row gathers its contiguous
     # span, located by binary search over the compacted key channel.
+    # Payloads ride the sort as operands (see the gather-cost note at
+    # srt0 — marginal sort operands are cheaper than T-sized gathers).
     key_c = jnp.where(ok, key, K)
-    comp = lax.sort(
-        (key_c, rep, ctr, elem, (ok & rm_k).astype(jnp.int32)),
-        dimension=-1, num_keys=1, is_stable=True)
+    comp = lax.sort((key_c, rep, ctr, elem, (ok & rm_k)),
+                    dimension=-1, num_keys=1, is_stable=True)
     ckey, crep, cctr, celem, crm = comp
     lo = jnp.searchsorted(ckey, jnp.arange(K, dtype=jnp.int32),
                           side="left")
@@ -291,7 +339,7 @@ def _apply_captured_batch(state: State, ops: base.OpBatch) -> State:
         "tag_rep": jnp.where(out_valid, crep[pos], SENTINEL),
         "tag_ctr": jnp.where(out_valid, cctr[pos], SENTINEL),
         "elem": jnp.where(out_valid, celem[pos], 0),
-        "removed": out_valid & (crm[pos] > 0),
+        "removed": out_valid & crm[pos],
         "valid": out_valid,
         "_rm_cap": state["_rm_cap"],
     }
@@ -467,7 +515,7 @@ def element_count(state: State) -> jnp.ndarray:
 
 def compact_fence(state: State, live_ops: base.OpBatch) -> State:
     """GC-fence compaction: reclaim tombstoned tags EXCEPT those whose
-    minting add is still in the live consensus window.
+    minting add may still be in the live consensus window.
 
     Soundness: a tombstoned tag's add op either (a) still rides a live
     block — protected here, because a view that has not yet applied that
@@ -479,14 +527,22 @@ def compact_fence(state: State, live_ops: base.OpBatch) -> State:
     so compacting ahead of them is harmless. Host pending queues cannot
     reference an unboarded tag: observation requires application, which
     requires boarding (service mints tags at ingest, but a tombstone only
-    ever captures an OBSERVED tag)."""
-    k, c = state["elem"].shape[-2], state["elem"].shape[-1]
-    from janus_tpu.ops import mark_members
-    prot = mark_members(
-        (state["tag_rep"].reshape(-1), state["tag_ctr"].reshape(-1)),
-        (live_ops["a1"], live_ops["a2"]),
-        (live_ops["op"] == OP_ADD),
-    ).reshape(k, c)
+    ever captures an OBSERVED tag).
+
+    Protection is a COUNTER WATERMARK, not set membership: tag counters
+    are minted monotonically (TagMinter/utils.ids — the GUID analog), so
+    any tag still ridable in the window has ctr >= the minimum ctr among
+    live buffered adds; tombstones at or above that watermark stay. This
+    over-protects tags minted concurrently with the window floor
+    (bounded by one window's mints; reclaimed at a later fence) but
+    replaces a [K*C + W*N*B]-record membership sort with one masked min
+    — the fence ran at every GC advance and the sort was ~40% of the
+    OR-Set consensus tick. A freshly joined replica minting from ctr=1
+    temporarily drags the watermark down — less compaction, never
+    unsoundness."""
+    is_add = live_ops["op"] == OP_ADD
+    wm = jnp.min(jnp.where(is_add, live_ops["a2"], SENTINEL))
+    prot = state["removed"] & (state["tag_ctr"] >= wm)
     return compact(state, protect=prot)
 
 

@@ -97,6 +97,23 @@ int janus_server_reply_batch(JanusServer* s, int n, const uint64_t* tags,
 long long janus_server_ops_received(JanusServer* s);
 long long janus_server_replies_sent(JanusServer* s);
 
+/* ---- native load generator (the benchmark client plane; reference
+ * BenchmarkRunners.cs:32-284 runs N .NET client threads — the Python
+ * client caps at ~25k ops/s process-wide, which would measure the
+ * driver instead of the server). One thread per connection, closed-loop
+ * `pipeline` window, batched writes, per-op latency stamped by seq.
+ * Keys must already exist ("o0".."o{n_keys-1}"). pct_get/pct_upd are
+ * percentages; the remainder are safe updates. Latency samples land in
+ * lat_ms_out/lat_cls_out (class 0=get 1=update 2=safeUpdate) up to
+ * lat_cap; counts[3] gets full per-class totals. Returns 0 on success,
+ * a negative worker errno class on connection failure. */
+int janus_loadgen_run(const char* host, int port, int conns,
+                      int ops_per_conn, int pipeline, int n_keys,
+                      const char* type_code, int pct_get, int pct_upd,
+                      uint64_t seed, double* elapsed_s, long long counts[3],
+                      float* lat_ms_out, uint8_t* lat_cls_out, int lat_cap,
+                      int* lat_n);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,295 @@
+// Native closed-loop load generator for the client plane.
+//
+// The reference drives its servers from .NET benchmark clients on a
+// separate VM (BFT-CRDT-Client/BenchmarkRunners.cs:32-284: N threads
+// round-robin over servers, per-op send/recv stamps, open-loop batches).
+// The Python client here tops out near ~25k ops/s for the WHOLE process
+// (GIL + per-op encode), which measures the driver, not the server — so
+// the wire benchmark's load side is native too: one thread per
+// connection, pre-encoded message templates, batched writes, a
+// closed-loop pipeline window, and per-op latency stamps keyed by
+// sequence number.
+//
+// Exposed through the same C API/ctypes binding as the server
+// (janus_loadgen_run); the bench harness uses it for wire-mode runs.
+#include "janus_native.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+void lg_put_varint(uint64_t v, std::vector<uint8_t>& out) {
+  do {
+    uint8_t b = v & 0x7f;
+    v >>= 7;
+    out.push_back(b | (v ? 0x80 : 0));
+  } while (v);
+}
+
+void lg_put_str(int field, const std::string& s, std::vector<uint8_t>& out) {
+  lg_put_varint(uint64_t(field) << 3 | 2, out);
+  lg_put_varint(s.size(), out);
+  out.insert(out.end(), s.begin(), s.end());
+}
+
+void lg_put_uint(int field, uint64_t v, std::vector<uint8_t>& out) {
+  lg_put_varint(uint64_t(field) << 3 | 0, out);
+  lg_put_varint(v, out);
+}
+
+struct XorShift {
+  uint64_t s;
+  explicit XorShift(uint64_t seed) : s(seed ? seed : 0x9e3779b97f4a7c15ull) {}
+  uint64_t next() {
+    s ^= s << 13;
+    s ^= s >> 7;
+    s ^= s << 17;
+    return s;
+  }
+};
+
+// one ClientMessage payload (schema per server.cc:13-23)
+void encode_msg(uint64_t seq, const std::string& key,
+                const std::string& type_code, const std::string& op,
+                const char* param, bool is_safe,
+                std::vector<uint8_t>& out) {
+  std::vector<uint8_t> body;
+  body.reserve(48);
+  lg_put_uint(1, 0, body);
+  lg_put_uint(2, seq, body);
+  lg_put_str(3, key, body);
+  lg_put_str(4, type_code, body);
+  lg_put_str(5, op, body);
+  lg_put_uint(6, is_safe ? 1 : 0, body);
+  if (param) lg_put_str(7, param, body);
+  lg_put_varint(body.size(), out);  // field-0 framing (bare length)
+  out.insert(out.end(), body.begin(), body.end());
+}
+
+// minimal reply parse: field 2 (seq). Returns false when incomplete.
+bool parse_reply_seq(const uint8_t* p, int len, uint64_t* seq) {
+  const uint8_t* end = p + len;
+  while (p < end) {
+    uint64_t tag = 0;
+    uint64_t v = 0;
+    int i = 0;
+    for (; p < end && i < 10; i++) {
+      uint8_t b = *p++;
+      tag |= uint64_t(b & 0x7f) << (7 * i);
+      if (!(b & 0x80)) break;
+    }
+    int field = int(tag >> 3), wt = int(tag & 7);
+    if (wt == 0) {
+      i = 0;
+      v = 0;
+      for (; p < end && i < 10; i++) {
+        uint8_t b = *p++;
+        v |= uint64_t(b & 0x7f) << (7 * i);
+        if (!(b & 0x80)) break;
+      }
+      if (field == 2) {
+        *seq = v;
+        return true;  // seq found; rest irrelevant
+      }
+    } else if (wt == 2) {
+      i = 0;
+      v = 0;
+      for (; p < end && i < 10; i++) {
+        uint8_t b = *p++;
+        v |= uint64_t(b & 0x7f) << (7 * i);
+        if (!(b & 0x80)) break;
+      }
+      if (p + v > end) return false;
+      p += v;
+    } else {
+      return false;
+    }
+  }
+  return false;
+}
+
+struct WorkerOut {
+  std::vector<float> lat_ms;
+  std::vector<uint8_t> cls;
+  long long done = 0;
+  int error = 0;
+};
+
+void worker(const char* host, int port, int wid, int total, int pipeline,
+            int n_keys, std::string type_code, int pct_get, int pct_upd,
+            uint64_t seed, WorkerOut* out) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    out->error = -1;
+    return;
+  }
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    out->error = -2;
+    close(fd);
+    return;
+  }
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    out->error = -3;
+    close(fd);
+    return;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // a lost reply (e.g. a server step that died mid-batch) must fail the
+  // run, not hang it forever in a blocking recv
+  timeval tv{};
+  tv.tv_sec = 120;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+
+  std::vector<std::string> keys(static_cast<size_t>(n_keys));
+  for (int k = 0; k < n_keys; k++) keys[size_t(k)] = "o" + std::to_string(k);
+  const bool pnc = type_code == "pnc";
+  const std::string op_get = "gp";
+  const std::string op_upd = pnc ? "i" : "a";
+  const std::string op_safe = pnc ? "d" : "a";
+  const char* get_param = pnc ? nullptr : "1";
+
+  XorShift rng(seed + uint64_t(wid) * 0x9e3779b9u + 1);
+  std::vector<Clock::time_point> stamps(size_t(total) + 1);
+  std::vector<uint8_t> op_cls(size_t(total) + 1);
+  out->lat_ms.reserve(size_t(total));
+  out->cls.reserve(size_t(total));
+
+  std::vector<uint8_t> sendbuf;
+  std::vector<uint8_t> recvbuf;
+  recvbuf.reserve(1 << 16);
+  uint8_t tmp[65536];
+  uint64_t seq = 0;
+  int outstanding = 0;
+  long long received = 0;
+
+  auto drain_once = [&](bool block) -> bool {
+    ssize_t n = recv(fd, tmp, sizeof(tmp), block ? 0 : MSG_DONTWAIT);
+    if (n <= 0) return false;
+    recvbuf.insert(recvbuf.end(), tmp, tmp + n);
+    size_t off = 0;
+    while (true) {
+      int poff = 0, plen = 0;
+      int used = janus_frame_decode0(recvbuf.data() + off,
+                                     int(recvbuf.size() - off), &poff, &plen);
+      if (used <= 0) break;
+      uint64_t rseq = 0;
+      if (parse_reply_seq(recvbuf.data() + off + poff, plen, &rseq) &&
+          rseq >= 1 && rseq <= seq) {
+        auto now = Clock::now();
+        float ms = std::chrono::duration<float, std::milli>(
+                       now - stamps[size_t(rseq)]).count();
+        out->lat_ms.push_back(ms);
+        out->cls.push_back(op_cls[size_t(rseq)]);
+        outstanding--;
+        received++;
+      }
+      off += size_t(used);
+    }
+    if (off) recvbuf.erase(recvbuf.begin(), recvbuf.begin() + long(off));
+    return true;
+  };
+
+  while (seq < uint64_t(total) || outstanding > 0) {
+    // fill the window with a batched write
+    if (seq < uint64_t(total) && outstanding < pipeline) {
+      sendbuf.clear();
+      int room = pipeline - outstanding;
+      auto now = Clock::now();
+      while (room-- > 0 && seq < uint64_t(total)) {
+        seq++;
+        uint64_t r = rng.next() % 100;
+        const std::string& key = keys[rng.next() % uint64_t(n_keys)];
+        uint8_t cls;
+        if (r < uint64_t(pct_get)) {
+          encode_msg(seq, key, type_code, op_get, get_param, false, sendbuf);
+          cls = 0;
+        } else if (r < uint64_t(pct_get + pct_upd)) {
+          encode_msg(seq, key, type_code, op_upd, "1", false, sendbuf);
+          cls = 1;
+        } else {
+          encode_msg(seq, key, type_code, op_safe, "1", true, sendbuf);
+          cls = 2;
+        }
+        stamps[seq] = now;
+        op_cls[seq] = cls;
+        outstanding++;
+      }
+      size_t sent = 0;
+      while (sent < sendbuf.size()) {
+        ssize_t n = send(fd, sendbuf.data() + sent, sendbuf.size() - sent, 0);
+        if (n <= 0) {
+          out->error = -4;
+          close(fd);
+          return;
+        }
+        sent += size_t(n);
+      }
+    }
+    if (outstanding > 0) {
+      // opportunistic drain; block only when the window is full or
+      // everything is sent (pure closed-loop wait)
+      bool block = outstanding >= pipeline || seq >= uint64_t(total);
+      if (!drain_once(block) && block) {
+        out->error = -5;
+        close(fd);
+        return;
+      }
+    }
+  }
+  out->done = received;
+  close(fd);
+}
+
+}  // namespace
+
+extern "C" int janus_loadgen_run(
+    const char* host, int port, int conns, int ops_per_conn, int pipeline,
+    int n_keys, const char* type_code, int pct_get, int pct_upd,
+    uint64_t seed, double* elapsed_s, long long counts[3],
+    float* lat_ms_out, uint8_t* lat_cls_out, int lat_cap, int* lat_n) {
+  std::vector<WorkerOut> outs(static_cast<size_t>(conns));
+  std::vector<std::thread> threads;
+  auto t0 = Clock::now();
+  for (int w = 0; w < conns; w++) {
+    threads.emplace_back(worker, host, port, w, ops_per_conn, pipeline,
+                         n_keys, std::string(type_code), pct_get, pct_upd,
+                         seed, &outs[size_t(w)]);
+  }
+  for (auto& t : threads) t.join();
+  *elapsed_s =
+      std::chrono::duration<double>(Clock::now() - t0).count();
+  counts[0] = counts[1] = counts[2] = 0;
+  int n = 0;
+  int err = 0;
+  for (auto& o : outs) {
+    if (o.error) err = o.error;
+    for (size_t i = 0; i < o.lat_ms.size(); i++) {
+      counts[o.cls[i]]++;
+      if (n < lat_cap) {
+        lat_ms_out[n] = o.lat_ms[i];
+        lat_cls_out[n] = o.cls[i];
+        n++;
+      }
+    }
+  }
+  *lat_n = n;
+  return err;
+}

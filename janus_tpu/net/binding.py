@@ -90,6 +90,13 @@ def load() -> ctypes.CDLL:
         for f in ("ops_received", "replies_sent"):
             getattr(lib, f"janus_server_{f}").argtypes = [c.c_void_p]
             getattr(lib, f"janus_server_{f}").restype = c.c_longlong
+        lib.janus_loadgen_run.argtypes = [
+            c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+            c.c_char_p, c.c_int, c.c_int, c.c_uint64,
+            c.POINTER(c.c_double), c.POINTER(c.c_longlong),
+            c.POINTER(c.c_float), u8p, c.c_int, i32p,
+        ]
+        lib.janus_loadgen_run.restype = c.c_int
         _lib = lib
         return lib
 
@@ -154,6 +161,8 @@ class NativeServer:
         if not self._h:
             raise RuntimeError("janus_server_create failed")
         self._started = False
+        self._poll_bufs: Optional[dict] = None
+        self._poll_cap = 0
 
     def start(self) -> int:
         rc = self._lib.janus_server_start(self._h)
@@ -174,32 +183,40 @@ class NativeServer:
     def poll_batch(self, cap: int):
         """Drain up to ``cap`` parsed ops. Returns a dict of numpy arrays
         (length = actual count): type_id, key_slot, op_code, is_safe,
-        p0..p2, client_tag."""
+        p0..p2, client_tag.
+
+        The returned arrays are VIEWS into per-server buffers reused by
+        the next poll_batch call — consume (or copy) them before polling
+        again. The service's step loop does; allocating ~9 cap-sized
+        arrays per step churned MBs/step at large caps."""
         c = ctypes
-        tid = np.empty(cap, np.int32)
-        key = np.empty(cap, np.int32)
-        opc = np.empty(cap, np.int32)
-        safe = np.empty(cap, np.uint8)
-        p0 = np.empty(cap, np.int64)
-        p1 = np.empty(cap, np.int64)
-        p2 = np.empty(cap, np.int64)
-        tag = np.empty(cap, np.uint64)
-        npar = np.empty(cap, np.int32)
+        if self._poll_bufs is None or cap > self._poll_cap:
+            self._poll_bufs = {
+                "type_id": np.empty(cap, np.int32),
+                "key_slot": np.empty(cap, np.int32),
+                "op_code": np.empty(cap, np.int32),
+                "is_safe": np.empty(cap, np.uint8),
+                "p0": np.empty(cap, np.int64),
+                "p1": np.empty(cap, np.int64),
+                "p2": np.empty(cap, np.int64),
+                "client_tag": np.empty(cap, np.uint64),
+                "n_params": np.empty(cap, np.int32),
+            }
+            self._poll_cap = cap
+        b = self._poll_bufs
 
         def ptr(a, t):
             return a.ctypes.data_as(c.POINTER(t))
 
         n = self._lib.janus_server_poll_batch(
-            self._h, cap, ptr(tid, c.c_int32), ptr(key, c.c_int32),
-            ptr(opc, c.c_int32), ptr(safe, c.c_uint8), ptr(p0, c.c_int64),
-            ptr(p1, c.c_int64), ptr(p2, c.c_int64), ptr(tag, c.c_uint64),
-            ptr(npar, c.c_int32),
+            self._h, cap,
+            ptr(b["type_id"], c.c_int32), ptr(b["key_slot"], c.c_int32),
+            ptr(b["op_code"], c.c_int32), ptr(b["is_safe"], c.c_uint8),
+            ptr(b["p0"], c.c_int64), ptr(b["p1"], c.c_int64),
+            ptr(b["p2"], c.c_int64), ptr(b["client_tag"], c.c_uint64),
+            ptr(b["n_params"], c.c_int32),
         )
-        return {
-            "type_id": tid[:n], "key_slot": key[:n], "op_code": opc[:n],
-            "is_safe": safe[:n], "p0": p0[:n], "p1": p1[:n], "p2": p2[:n],
-            "client_tag": tag[:n], "n_params": npar[:n],
-        }
+        return {f: v[:n] for f, v in b.items()}
 
     def key_count(self, type_id: int) -> int:
         return self._lib.janus_server_key_count(self._h, type_id)
@@ -254,6 +271,34 @@ class NativeServer:
 
     def ops_received(self) -> int:
         return self._lib.janus_server_ops_received(self._h)
+
+    @staticmethod
+    def loadgen_run(host: str, port: int, conns: int, ops_per_conn: int,
+                    pipeline: int, n_keys: int, type_code: str,
+                    pct_get: int, pct_upd: int, seed: int = 1):
+        """Run the native closed-loop load generator against a server
+        (keys o0..o{n_keys-1} must exist). Returns
+        ``(elapsed_s, counts[3], lat_ms, lat_cls)`` — latency sample
+        arrays with class 0=get, 1=update, 2=safeUpdate."""
+        c = ctypes
+        lib = load()
+        cap = conns * ops_per_conn
+        lat = np.empty(cap, np.float32)
+        cls = np.empty(cap, np.uint8)
+        counts = (c.c_longlong * 3)()
+        elapsed = c.c_double(0.0)
+        n = c.c_int(0)
+        rc = lib.janus_loadgen_run(
+            host.encode(), port, conns, ops_per_conn, pipeline, n_keys,
+            type_code.encode(), pct_get, pct_upd, c.c_uint64(seed),
+            c.byref(elapsed), counts,
+            lat.ctypes.data_as(c.POINTER(c.c_float)),
+            cls.ctypes.data_as(c.POINTER(c.c_uint8)), cap, c.byref(n))
+        if rc != 0:
+            raise RuntimeError(f"loadgen failed ({rc})")
+        k = n.value
+        return (float(elapsed.value), [int(v) for v in counts],
+                lat[:k].copy(), cls[:k].copy())
 
     def replies_sent(self) -> int:
         return self._lib.janus_server_replies_sent(self._h)

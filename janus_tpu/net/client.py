@@ -104,6 +104,8 @@ def decode_reply(payload: bytes) -> Dict[str, object]:
                 out["ok"] = bool(v)
         elif wt == 2:
             n, off = _read_varint(payload, off)
+            if n is None or off + n > len(payload):
+                break  # truncated length-delimited field: stop parsing
             s = payload[off: off + n].decode(errors="replace")
             off += n
             if field == 9:

@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional
 
 from janus_tpu.net.client import _read_varint, _varint, frame
 from janus_tpu.net.dagplane import TcpPeer
+from janus_tpu.utils.log import get_logger
 
 MSG_TYPED = 8
 MSG_CREATE = 9
@@ -53,6 +54,7 @@ class DagFabric:
                  on_create: Callable[[int, str, int, int], None]):
         self.addresses = addresses  # [(host, port)] per process
         self.index = proc_index
+        self.log = get_logger("fabric", f"p{proc_index}")
         self.on_type_frame = on_type_frame
         self.on_create = on_create
         self.peers: Dict[int, TcpPeer] = {}
@@ -81,19 +83,23 @@ class DagFabric:
             if j >= self.index:
                 continue
             last = None
-            for _ in range(self.CONNECT_RETRIES):
+            for attempt in range(self.CONNECT_RETRIES):
                 try:
                     sock = socket.create_connection((h, p), timeout=10)
                     break
                 except OSError as e:
                     last = e
+                    if attempt % 10 == 9:
+                        self.log.info("still dialing peer %d at %s:%d "
+                                      "(%s)", j, h, p, e)
                     time.sleep(self.RETRY_DELAY)
             else:
                 raise ConnectionError(f"peer {j} at {h}:{p}: {last}")
-            peer = TcpPeer(sock, self._receiver(j))
+            peer = TcpPeer(sock, self._receiver(j), name=f"peer{j}")
             peer.send(frame(_varint(self.index), MSG_HELLO))
             with self._lock:
                 self.peers[j] = peer
+            self.log.debug("dialed peer %d at %s:%d", j, h, p)
 
         deadline = time.monotonic() + self.CONNECT_RETRIES * self.RETRY_DELAY
         want = len(self.addresses) - 1
@@ -130,6 +136,8 @@ class DagFabric:
                         # junk dialer (wrong port/protocol): close it —
                         # keeping the socket would buffer its bytes
                         # without bound and leak the receiver thread
+                        self.log.warning(
+                            "dropping non-hello dialer (tag %d)", tag >> 3)
                         holder["idx"] = -1
                         buf.clear()
                         holder["peer"].close()
@@ -139,12 +147,18 @@ class DagFabric:
                     holder["idx"] = int(idx)
                     with self._lock:
                         self.peers[holder["idx"]] = holder["peer"]
+                    self.log.debug("accepted peer %d", holder["idx"])
                 idx = holder["idx"]
                 if idx >= 0 and buf:
                     data, holder["buf"] = bytes(buf), bytearray()
                     self._on_bytes(idx, data)
 
-            holder["peer"] = TcpPeer(sock, on_first)
+            # construct unstarted, register, THEN start reception: on
+            # loopback the dialer's hello is typically already in the
+            # kernel buffer, and on_first dereferences holder["peer"]
+            peer = TcpPeer(sock, on_first, start=False, name="accepted")
+            holder["peer"] = peer
+            peer.start()
 
     def _receiver(self, idx: int):
         return lambda data: self._on_bytes(idx, data)
@@ -161,6 +175,8 @@ class DagFabric:
                     break
                 n, off = _read_varint(buf, off)
             except ValueError:
+                self.log.warning("corrupt frame from peer %d: dropping "
+                                 "%d buffered bytes", idx, len(buf))
                 buf.clear()  # unterminated varint: drop the corrupt
                 break        # buffer instead of killing the recv thread
             if n is None or off + n > len(buf):
@@ -191,8 +207,9 @@ class DagFabric:
         for p in peers:
             try:
                 p.send(data)
-            except OSError:
-                pass  # dead peer: quorum machinery tolerates its absence
+            except OSError as e:
+                # dead peer: quorum machinery tolerates its absence
+                self.log.debug("send to %s failed: %s", p.name, e)
 
     def type_sender(self, type_idx: int):
         """A SplitNode ``send`` callback wrapping frames for one type."""

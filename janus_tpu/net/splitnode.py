@@ -43,6 +43,7 @@ from janus_tpu.models import base
 from janus_tpu.net import binding
 from janus_tpu.net.client import _read_varint, _varint, frame
 from janus_tpu.runtime.safecrdt import SafeKV
+from janus_tpu.utils.log import get_logger
 
 # DAG-plane subtype framing (field number = message type; CMNode.cs:81).
 # 2/3/4 existed in round 3 (structure-only); 5-7 are new.
@@ -124,6 +125,7 @@ class SplitNode:
         self.owned_idx = np.nonzero(self.owned)[0]
         self.kv = SplitSafeKV(cfg, spec, ops_per_block, self.owned, **dims)
         self.B = ops_per_block
+        self.log = get_logger("splitnode", spec.type_code)
         self.send = send or (lambda data: None)
         self.use_ecdsa = binding.ecdsa_available()
         rng = np.random.default_rng(int(self.owned_idx[0]) + 1)
@@ -283,17 +285,31 @@ class SplitNode:
         digest = self._digest_block(r, src, edge_bytes, ops)
         if not self._verify(int(src), digest, sig):
             self.stats["verified_bad"] += 1  # tampered/forged: drop
+            self.log.warning("dropping tampered/forged block (round %d, "
+                             "source %d)", r, src)
             return
         rows = self._decode_ops(ops)
         if rows is None:
             self.stats["verified_bad"] += 1
             return
-        self.stats["verified_ok"] += 1
         key = (int(r), int(src))
-        if key not in self._digests:
-            self._digests[key] = digest
-            # keep the frame for peer repair (block query replay)
-            self._frames[key] = frame(payload, MSG_BLOCK_OPS)
+        prev = self._digests.get(key)
+        if prev is not None:
+            # first block for (round, source) wins EVERYWHERE: a second,
+            # differently-signed copy is equivocation by the creator —
+            # admitting it to acc would let payload B be applied while
+            # sigs/certs verify against digest A (processes diverge).
+            # An identical re-send (query replay) carries nothing new.
+            if prev != digest:
+                self.stats["verified_bad"] += 1
+                self.log.warning("equivocation: second distinct signed "
+                                 "block for (round %d, source %d) dropped",
+                                 r, src)
+            return
+        self.stats["verified_ok"] += 1  # counted once per ADMITTED block
+        self._digests[key] = digest
+        # keep the frame for peer repair (block query replay)
+        self._frames[key] = frame(payload, MSG_BLOCK_OPS)
         acc["blocks"].append((int(r), int(src), edges, rows))
 
     def _handle_sig(self, payload: bytes) -> None:
@@ -429,7 +445,7 @@ class SplitNode:
             if self._slot_ready(r):
                 ready_blocks.append((r, s, e, rows))
             elif r >= base_round:
-                self._parked_blocks[(r, s)] = (e, rows)
+                self._parked_blocks.setdefault((r, s), (e, rows))
             else:
                 self.stats["stale_dropped"] += 1
         for (r, s), (e, rows) in list(self._parked_blocks.items()):
@@ -520,6 +536,11 @@ class SplitNode:
                 _put_bytes(body, sig)
                 out += frame(bytes(body), MSG_SIG)
 
+        # certs we cannot yet prove (sig store lacking quorum at the
+        # instant cert_exists flips) must NOT enter the prev snapshot,
+        # or they would never be retried and peers would permanently
+        # miss them
+        prev_ce_next = np.array(cur_ce, copy=True)
         for s, v in zip(*np.nonzero(cur_ce & ~self._prev_ce)):
             if not self.owned[v]:
                 continue
@@ -528,7 +549,8 @@ class SplitNode:
             signers = [int(t) for t in np.nonzero(cur_acks[s, v])[0]
                        if int(t) in sigs]
             if len(signers) < self.cfg.quorum:
-                continue  # cannot prove the certificate yet
+                prev_ce_next[s, v] = False  # retry on a later step
+                continue
             body = bytearray(_varint(r) + _varint(int(v))
                              + _varint(len(signers)))
             for t in signers:
@@ -538,7 +560,7 @@ class SplitNode:
 
         self._prev_be = cur_be
         self._prev_acks = cur_acks
-        self._prev_ce = cur_ce
+        self._prev_ce = prev_ce_next
         if out:
             self.send(bytes(out))
 

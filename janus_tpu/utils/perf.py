@@ -54,3 +54,22 @@ class PerfCounter:
             "ops_per_sec_recent": self.samples(10),
             "uptime_sec": round(dt, 3),
         }
+
+
+def backend_rtt(reps: int = 5) -> float:
+    """Measured dispatch+fetch round trip of a trivial jitted kernel —
+    the observation floor every wall-clock reading on a remote/tunneled
+    backend includes (~100 ms through the relay here, ~0.1 ms
+    co-located). One definition so every benchmark subtracts/reports
+    the SAME floor (bench.py consensus + latency decomposition, harness
+    read timing)."""
+    import jax
+    import numpy as np
+
+    probe = jax.jit(lambda x: x + 1)
+    x = probe(np.zeros((4,), np.int32))
+    np.asarray(x)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.asarray(probe(x))
+    return (time.perf_counter() - t0) / reps

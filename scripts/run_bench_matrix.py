@@ -3,10 +3,11 @@
 
 Reference: BFT-CRDT-Client/scripts/multibench.py:23-115 +
 run_multi_bench.py — vary one primary variable across runs, collect
-results. Here: run named harness presets and/or the banking app, write
-one JSON line per run to results.jsonl.
+results. Here: run named harness presets, preset sweeps, and/or the
+banking app, write one JSON line per run to results.jsonl.
 
     python scripts/run_bench_matrix.py --presets pnc orset rga --banking
+    python scripts/run_bench_matrix.py --orset-sweep 100 1000 2000 5000
 """
 from __future__ import annotations
 
@@ -20,14 +21,25 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--presets", nargs="*", default=["pnc"])
+    ap.add_argument("--presets", nargs="*", default=[])
+    ap.add_argument("--orset-sweep", nargs="*", type=int, default=[],
+                    help="object-count sweep over the orset4 preset "
+                         "(paper §6.2 Fig 6: PNC flat to 5k objects, "
+                         "OR-Set collapses past 2k)")
     ap.add_argument("--banking", action="store_true")
     ap.add_argument("--banking-wan", action="store_true",
                     help="banking under emulated 50+/-10 ms WAN "
                          "(paper §6.3 Fig 12 configuration)")
+    ap.add_argument("--banking-clients", type=int, default=16)
+    ap.add_argument("--banking-txns", type=int, default=400)
     ap.add_argument("--out", default="results.jsonl")
     args = ap.parse_args()
+    if not (args.presets or args.orset_sweep or args.banking
+            or args.banking_wan):
+        ap.error("nothing selected: pass --presets, --orset-sweep, "
+                 "--banking, and/or --banking-wan")
 
+    import dataclasses as dc
     import time
 
     from janus_tpu.bench.harness import PRESETS, run
@@ -43,14 +55,19 @@ def main() -> None:
         for name in args.presets:
             res = run(PRESETS[name])
             emit(f, name, res.to_dict())
+        for n_obj in args.orset_sweep:
+            cfg = dc.replace(PRESETS["orset4"],
+                             name=f"orset_4rep_{n_obj}obj",
+                             num_objects=n_obj)
+            emit(f, f"orset_objsweep_{n_obj}", run(cfg).to_dict())
         if args.banking or args.banking_wan:
-            import dataclasses as dc
-
             from janus_tpu.bench.banking import BankingConfig, run_banking
+            base = BankingConfig(clients=args.banking_clients,
+                                 txns_per_client=args.banking_txns)
             if args.banking:
-                emit(f, "banking", run_banking(BankingConfig()).to_dict())
+                emit(f, "banking", run_banking(base).to_dict())
             if args.banking_wan:
-                cfg = dc.replace(BankingConfig(), wan_delay_ms=50.0,
+                cfg = dc.replace(base, wan_delay_ms=50.0,
                                  wan_jitter_ms=10.0)
                 emit(f, "banking_wan", run_banking(cfg).to_dict())
 

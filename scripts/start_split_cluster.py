@@ -1,23 +1,30 @@
 #!/usr/bin/env python
 """Split-cluster launcher: one JanusService process per cluster-JSON
-entry, full-mesh DAG plane, per-process client ports.
+entry, full-mesh DAG plane, per-process client ports — local spawn or
+remote deploy over ssh/scp.
 
 Reference: BFT-CRDT-Client/scripts/start_servers.py — generates per-node
-cluster JSONs, spawns one server process per replica, writes pid files,
-stop/status commands (:27-328). Here one cluster config describes every
-process; each process is started with its index.
+cluster JSONs, ships binaries + configs to remote hosts over scp, starts
+one server process per replica over ssh, and collects pid/ip files
+(:27-328, remote start :137-162, pid collection :212-238). Here one
+cluster config describes every process; a proc entry with an ``"ssh"``
+field is deployed remotely, everything else spawns locally.
 
 Usage:
-  python scripts/start_split_cluster.py start cluster.json [--logdir DIR]
-  python scripts/start_split_cluster.py stop  [--logdir DIR]
+  python scripts/start_split_cluster.py deploy cluster.json  # rsync repo
+  python scripts/start_split_cluster.py start  cluster.json [--logdir DIR]
+                                               [--log-level LEVEL]
+  python scripts/start_split_cluster.py stop   [--logdir DIR]
   python scripts/start_split_cluster.py status [--logdir DIR]
 
-Cluster JSON (JanusConfig.from_json shape + per-proc client ports):
+Cluster JSON (JanusConfig.from_json shape + per-proc client ports; the
+optional ``ssh``/``workdir`` fields make a proc remote):
   {"num_nodes": 4, "window": 8, "ops_per_block": 16,
    "types": [{"type_code": "pnc", "dims": {"num_keys": 64}}],
    "procs": [
-     {"address": "127.0.0.1", "dag_port": 7100, "owned": [0, 1],
-      "client_port": 5100},
+     {"address": "10.0.0.1", "dag_port": 7100, "owned": [0, 1],
+      "client_port": 5100, "ssh": "ubuntu@10.0.0.1",
+      "workdir": "/home/ubuntu/janus"},
      {"address": "127.0.0.1", "dag_port": 7101, "owned": [2, 3],
       "client_port": 5101}]}
 """
@@ -26,15 +33,71 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shlex
 import signal
 import subprocess
 import sys
 import time
 
 DEFAULT_LOGDIR = "/tmp/janus_split"
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def start(cluster_json: str, logdir: str) -> None:
+# subprocess seam (tests stub this to assert the remote command shapes
+# without an sshd; the reference's script shells out the same way,
+# start_servers.py:137-162)
+def _run(cmd, **kw):
+    return subprocess.run(cmd, **kw)
+
+
+def _rpath(p: str) -> str:
+    """Quote a remote path for use inside an ssh command, keeping a
+    leading ``~`` expandable (shlex.quote('~/x') would make the remote
+    shell treat it as a literal tilde directory)."""
+    if p == "~":
+        return '"$HOME"'
+    if p.startswith("~/"):
+        return f'"$HOME/{p[2:]}"'
+    return shlex.quote(p)
+
+
+def remote_deploy_cmds(ssh: str, workdir: str):
+    """rsync the repo to a remote host (the reference scp's built
+    binaries; a Python tree rsyncs)."""
+    return [
+        ["ssh", ssh, f"mkdir -p {_rpath(workdir)}"],
+        # native build artifacts must NOT ship: preserved mtimes would
+        # defeat the binding's staleness check and the remote would load
+        # a foreign-platform binary instead of rebuilding
+        ["rsync", "-a", "--delete",
+         "--exclude", ".git", "--exclude", "__pycache__",
+         "--exclude", "*.so", "--exclude", "*.o",
+         f"{REPO_ROOT}/", f"{ssh}:{workdir}/"],
+    ]
+
+
+def remote_start_cmds(ssh: str, workdir: str, cfg_path: str, index: int,
+                      logdir: str, log_level: str):
+    """Ship the per-proc config and start the service detached; the
+    final ssh echoes the remote pid (collected into the pids file as
+    ``ssh_target:pid``)."""
+    rcfg = f"{logdir}/proc{index}.json"
+    rlog = f"{logdir}/proc{index}.log"
+    start_cmd = (
+        f"mkdir -p {_rpath(logdir)} && "
+        f"cd {_rpath(workdir)} && "
+        f"nohup python -m janus_tpu.net.service {_rpath(rcfg)} "
+        f"{index} --log-level {shlex.quote(log_level)} "
+        f"> {_rpath(rlog)} 2>&1 & echo $!"
+    )
+    return [
+        ["ssh", ssh, f"mkdir -p {_rpath(logdir)}"],
+        ["scp", "-q", cfg_path, f"{ssh}:{rcfg}"],
+        ["ssh", ssh, start_cmd],
+    ]
+
+
+def start(cluster_json: str, logdir: str, log_level: str = "info") -> None:
     os.makedirs(logdir, exist_ok=True)
     cfg = json.loads(open(cluster_json).read())
     procs = cfg.get("procs", [])
@@ -46,69 +109,113 @@ def start(cluster_json: str, logdir: str) -> None:
         per["proc_index"] = i
         per["port"] = int(p.get("client_port", 0))
         per["bind_addr"] = p.get("address", "127.0.0.1")
+        per["log_level"] = log_level
         cfg_path = os.path.join(logdir, f"proc{i}.json")
         with open(cfg_path, "w") as f:
             json.dump(per, f)
-        log = open(os.path.join(logdir, f"proc{i}.log"), "w")
-        child = subprocess.Popen(
-            [sys.executable, "-m", "janus_tpu.net.service", cfg_path, str(i)],
-            stdout=log, stderr=subprocess.STDOUT,
-        )
-        pids.append(child.pid)
-        print(f"proc {i}: pid {child.pid} client={per['bind_addr']}:"
-              f"{per['port']} dag={p['address']}:{p['dag_port']} "
-              f"owned={p['owned']}")
+        ssh = p.get("ssh")
+        if ssh:
+            workdir = p.get("workdir", "~/janus")
+            pid = None
+            for cmd in remote_start_cmds(ssh, workdir, cfg_path, i,
+                                         logdir, log_level):
+                out = _run(cmd, check=True, capture_output=True, text=True)
+                pid = (out.stdout or "").strip() or pid
+            pids.append(f"{ssh}:{pid}")
+            print(f"proc {i}: remote {ssh} pid {pid} "
+                  f"client={per['bind_addr']}:{per['port']} "
+                  f"dag={p['address']}:{p['dag_port']} owned={p['owned']}")
+        else:
+            log = open(os.path.join(logdir, f"proc{i}.log"), "w")
+            child = subprocess.Popen(
+                [sys.executable, "-m", "janus_tpu.net.service", cfg_path,
+                 str(i), "--log-level", log_level],
+                stdout=log, stderr=subprocess.STDOUT,
+            )
+            pids.append(str(child.pid))
+            print(f"proc {i}: pid {child.pid} client={per['bind_addr']}:"
+                  f"{per['port']} dag={p['address']}:{p['dag_port']} "
+                  f"owned={p['owned']}")
     with open(os.path.join(logdir, "pids"), "w") as f:
-        f.write("\n".join(map(str, pids)))
+        f.write("\n".join(pids))
     print(f"{len(pids)} processes started; logs in {logdir}")
+
+
+def deploy(cluster_json: str) -> None:
+    cfg = json.loads(open(cluster_json).read())
+    seen = set()
+    for p in cfg.get("procs", []):
+        ssh = p.get("ssh")
+        if not ssh or ssh in seen:
+            continue
+        seen.add(ssh)
+        workdir = p.get("workdir", "~/janus")
+        for cmd in remote_deploy_cmds(ssh, workdir):
+            print("+", " ".join(cmd))
+            _run(cmd, check=True)
+    if not seen:
+        print("no remote procs in config; nothing to deploy")
 
 
 def _read_pids(logdir: str):
     path = os.path.join(logdir, "pids")
     if not os.path.exists(path):
         return []
-    return [int(x) for x in open(path).read().split()]
+    return open(path).read().split()
+
+
+def _signal_entry(entry: str, sig_name: str, check_only: bool = False):
+    """Signal one pids-file entry: ``pid`` locally, ``ssh_target:pid``
+    over ssh. Returns True if the process is (still) alive."""
+    if ":" in entry:
+        ssh, pid = entry.rsplit(":", 1)
+        cmd = f"kill -0 {pid}" if check_only else f"kill -{sig_name} {pid}"
+        return _run(["ssh", ssh, cmd], capture_output=True).returncode == 0
+    pid = int(entry)
+    try:
+        os.kill(pid, 0 if check_only else getattr(signal, f"SIG{sig_name}"))
+        return True
+    except ProcessLookupError:
+        return False
 
 
 def stop(logdir: str) -> None:
-    for pid in _read_pids(logdir):
-        try:
-            os.kill(pid, signal.SIGINT)
-            print(f"SIGINT -> {pid}")
-        except ProcessLookupError:
-            print(f"{pid} already gone")
-    deadline = time.time() + 10
-    for pid in _read_pids(logdir):
-        while time.time() < deadline:
-            try:
-                os.kill(pid, 0)
-                time.sleep(0.2)
-            except ProcessLookupError:
-                break
+    for entry in _read_pids(logdir):
+        if _signal_entry(entry, "INT"):
+            print(f"SIGINT -> {entry}")
         else:
-            os.kill(pid, signal.SIGKILL)
-            print(f"SIGKILL -> {pid}")
+            print(f"{entry} already gone")
+    deadline = time.time() + 10
+    for entry in _read_pids(logdir):
+        while time.time() < deadline:
+            if not _signal_entry(entry, "INT", check_only=True):
+                break
+            time.sleep(0.2)
+        else:
+            _signal_entry(entry, "KILL")
+            print(f"SIGKILL -> {entry}")
 
 
 def status(logdir: str) -> None:
-    for pid in _read_pids(logdir):
-        try:
-            os.kill(pid, 0)
-            print(f"{pid} running")
-        except ProcessLookupError:
-            print(f"{pid} dead")
+    for entry in _read_pids(logdir):
+        alive = _signal_entry(entry, "INT", check_only=True)
+        print(f"{entry} {'running' if alive else 'dead'}")
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("command", choices=["start", "stop", "status"])
+    ap.add_argument("command", choices=["start", "stop", "status", "deploy"])
     ap.add_argument("cluster_json", nargs="?")
     ap.add_argument("--logdir", default=DEFAULT_LOGDIR)
+    ap.add_argument("--log-level", default="info",
+                    choices=["debug", "info", "warning", "error", "off"])
     args = ap.parse_args()
+    if args.command in ("start", "deploy") and not args.cluster_json:
+        sys.exit(f"{args.command} needs a cluster JSON")
     if args.command == "start":
-        if not args.cluster_json:
-            sys.exit("start needs a cluster JSON")
-        start(args.cluster_json, args.logdir)
+        start(args.cluster_json, args.logdir, args.log_level)
+    elif args.command == "deploy":
+        deploy(args.cluster_json)
     elif args.command == "stop":
         stop(args.logdir)
     else:

@@ -1,0 +1,100 @@
+"""Launcher + logging + client-codec unit tests (round-5 additions):
+remote ssh/scp deployment command shapes (reference
+start_servers.py:137-162), the --log-level verbosity plumbing
+(Globals.cs:16-49 analog), and reply-codec robustness to truncation."""
+import importlib.util
+import json
+import logging
+import os
+import sys
+
+import pytest
+
+spec = importlib.util.spec_from_file_location(
+    "start_split_cluster",
+    os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                 "start_split_cluster.py"))
+launcher = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(launcher)
+
+
+def test_remote_start_cmds_shape():
+    cmds = launcher.remote_start_cmds(
+        "ubuntu@10.0.0.1", "/home/ubuntu/janus", "/tmp/x/proc0.json", 0,
+        "/tmp/janus_split", "debug")
+    assert cmds[0][:2] == ["ssh", "ubuntu@10.0.0.1"]
+    assert cmds[1][0] == "scp" and cmds[1][-1].endswith(":/tmp/janus_split/proc0.json")
+    start = cmds[2][2]
+    assert "cd /home/ubuntu/janus" in start
+    assert "-m janus_tpu.net.service" in start
+    assert "--log-level debug" in start
+    assert start.endswith("echo $!")  # pid collection
+
+
+def test_remote_deploy_cmds_shape():
+    cmds = launcher.remote_deploy_cmds("u@h", "/w")
+    assert cmds[0] == ["ssh", "u@h", "mkdir -p /w"]
+    assert cmds[1][0] == "rsync" and cmds[1][-1] == "u@h:/w/"
+
+
+def test_start_remote_collects_ssh_pid(tmp_path, monkeypatch):
+    calls = []
+
+    class Out:
+        stdout = "12345\n"
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        return Out()
+
+    monkeypatch.setattr(launcher, "_run", fake_run)
+    cfg = {
+        "num_nodes": 2, "window": 8, "ops_per_block": 8,
+        "types": [{"type_code": "pnc", "dims": {"num_keys": 8}}],
+        "procs": [
+            {"address": "10.0.0.1", "dag_port": 7100, "owned": [0],
+             "client_port": 5100, "ssh": "u@10.0.0.1", "workdir": "/w"},
+        ],
+    }
+    p = tmp_path / "cluster.json"
+    p.write_text(json.dumps(cfg))
+    launcher.start(str(p), str(tmp_path / "logs"), "info")
+    pids = (tmp_path / "logs" / "pids").read_text().split()
+    assert pids == ["u@10.0.0.1:12345"]
+    assert any(c[0] == "scp" for c in calls)
+    # the shipped per-proc config carries the log level
+    shipped = json.loads((tmp_path / "logs" / "proc0.json").read_text())
+    assert shipped["log_level"] == "info"
+    assert shipped["proc_index"] == 0
+
+
+def test_log_configure_levels():
+    from janus_tpu.utils.log import LEVELS, configure, get_logger
+    configure("warning")
+    root = logging.getLogger("janus")
+    assert root.level == logging.WARNING
+    lg = get_logger("fabric", "p3")
+    assert lg.name == "janus.fabric.p3"
+    assert not lg.isEnabledFor(logging.INFO)
+    configure("debug")
+    assert lg.isEnabledFor(logging.DEBUG)
+    with pytest.raises(ValueError):
+        configure("loud")
+    assert set(LEVELS) == {"debug", "info", "warning", "error", "off"}
+    configure("info")
+
+
+def test_decode_reply_truncated_field_is_safe():
+    from janus_tpu.net.client import _varint, decode_reply
+    # field 9 (payload, wire type 2) claiming 100 bytes but truncated
+    evil = _varint(2 << 3) + _varint(7) + _varint(9 << 3 | 2) + _varint(100)
+    out = decode_reply(evil + b"abc")
+    assert out["seq"] == 7          # fields before the truncation parse
+    assert out["payload"] == ""     # truncated field ignored, no raise
+
+
+def test_service_log_level_cli_parse(tmp_path):
+    # the service main's flag parsing: --log-level anywhere in argv
+    from janus_tpu.net.service import JanusConfig
+    cfg = JanusConfig.from_json(json.dumps({"log_level": "debug"}))
+    assert cfg.log_level == "debug"
