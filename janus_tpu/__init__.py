@@ -28,3 +28,18 @@ utils      config, id interning, perf counters
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS") == "cpu":
+    # Honor an explicit CPU request HERE, before any submodule import
+    # can initialize the backend: a site hook may force-register a
+    # tunneled device platform ahead of CPU regardless of JAX_PLATFORMS,
+    # and several models build module-level jnp constants — once the
+    # backend initializes on the tunnel, every device fetch costs a
+    # ~100 ms network round trip (a split-cluster service degrades from
+    # ~20 ticks/s to ~1). tests/conftest.py and the bench entry points
+    # carry the same pin for processes that import jax first.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")

@@ -666,6 +666,20 @@ PRESETS = {
                           num_nodes=4, window=8, num_objects=100,
                           ops_per_block=8192, ticks=24, orset_capacity=64,
                           orset_rm_capacity=4, ops_ratio=(0.0, 1.0, 0.0)),
+    # node-count scaling mid point (paper §6.2 Fig 10: OR-Set loses
+    # ~40% from 4 -> 8 nodes, then flattens 12 -> 16)
+    "orset8": BenchConfig(name="orset_8rep_scaling", type_code="orset",
+                          num_nodes=8, window=8, num_objects=100,
+                          ops_per_block=8192, ticks=20, orset_capacity=64,
+                          orset_rm_capacity=4, ops_ratio=(0.0, 1.0, 0.0)),
+    # light-load latency geometry: small blocks keep the tick (and so
+    # the op->commit wall clock) low — the reference's latency figures
+    # are light-load for the same reason (1000 ops/s send rate, Fig 7)
+    "orset_light": BenchConfig(name="orset_16rep_light", type_code="orset",
+                               num_nodes=16, window=8, num_objects=1000,
+                               ops_per_block=256, ticks=48,
+                               orset_capacity=64, orset_rm_capacity=4,
+                               ops_ratio=(0.0, 1.0, 0.0)),
     # 64-node two-type emulation: all 64 views' unions run on one chip,
     # so the tick is heavy — sized for a ~5-minute run
     "mixed": BenchConfig(name="mixed_zipf_64rep", type_code="mixed",
@@ -673,10 +687,26 @@ PRESETS = {
                          ops_per_block=64, ticks=24, key_pattern="zipf",
                          orset_capacity=256, orset_rm_capacity=8,
                          ops_ratio=(0.3, 0.5, 0.2)),
+    # window 16: the bounded ring deadlocks if a run of dead-leader
+    # waves (crashed or pruned-byzantine leaders) spans the in-flight
+    # W/2 waves — the liveness bound documented at safecrdt's GC.
+    # Measured: n=8 with nodes {6,7} crashed hits a 3-run (waves 6,7,8
+    # of the leader mix) and freezes a W=8 ring at base_round 10; W=16
+    # rides out runs up to 5. The reference never deadlocks only
+    # because its DAG grows without bound (DAG.cs GC comment).
     "byzantine": BenchConfig(name="byzantine_orset", type_code="orset",
-                             num_nodes=16, num_objects=500, ops_per_block=256,
+                             num_nodes=16, window=16, num_objects=500,
+                             ops_per_block=256,
                              byzantine=4, invalid_rate=0.25,
                              ops_ratio=(0.0, 0.8, 0.2)),
+    # fault-free CONTROL at the byzantine geometry (same secure path,
+    # zero injected invalid certs) — the Fig 11 comparison is the DELTA
+    # against this, not against an insecure-path run
+    "byzantine0": BenchConfig(name="byzantine_orset_control",
+                              type_code="orset", num_nodes=16, window=16,
+                              num_objects=500, ops_per_block=256,
+                              byzantine=4, invalid_rate=0.0,
+                              ops_ratio=(0.0, 0.8, 0.2)),
     # BASELINE config 5: 1k replicas, >=1M applied inserts (plus the
     # matching deletes) with mid-run compaction — 1024 x 16 lanes x 64
     # ticks = 1,048,576 inserts; live state stays ~bounded via the
@@ -703,13 +733,18 @@ PRESETS = {
                                num_objects=100, ops_per_block=4096,
                                clients=16, ops_per_client=60000,
                                pipeline=1024, ops_ratio=(0.3, 0.6, 0.1)),
-    # crash-fault pair (paper §6.2 Fig 11: 8 nodes, 0 vs 2 crashed)
+    # crash-fault pair (paper §6.2 Fig 11: 8 nodes, 0 vs 2 crashed);
+    # window 16 on BOTH so the with/without-crash delta compares like
+    # for like (see the byzantine note for why faults need the bigger
+    # ring)
     "pnc8": BenchConfig(name="pnc_8rep_baseline", type_code="pnc",
-                        num_nodes=8, num_objects=100, ops_per_block=1000,
-                        ticks=60, ops_ratio=(0.2, 0.6, 0.2)),
+                        num_nodes=8, window=16, num_objects=100,
+                        ops_per_block=1000, ticks=60,
+                        ops_ratio=(0.2, 0.6, 0.2)),
     "crash": BenchConfig(name="pnc_8rep_2crashed", type_code="pnc",
-                         num_nodes=8, num_objects=100, ops_per_block=1000,
-                         ticks=60, crashed=2, ops_ratio=(0.2, 0.6, 0.2)),
+                         num_nodes=8, window=16, num_objects=100,
+                         ops_per_block=1000, ticks=60, crashed=2,
+                         ops_ratio=(0.2, 0.6, 0.2)),
 }
 
 

@@ -1085,6 +1085,9 @@ def main(argv=None) -> None:
     import signal
     import sys
 
+    # (the JAX_PLATFORMS=cpu backend pin lives in janus_tpu/__init__.py,
+    # which always runs before this module body — see the note there)
+
     args = sys.argv[1:] if argv is None else argv
     log_level = None
     rest = []

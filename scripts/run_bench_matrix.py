@@ -32,12 +32,15 @@ def main() -> None:
                          "(paper §6.3 Fig 12 configuration)")
     ap.add_argument("--banking-clients", type=int, default=16)
     ap.add_argument("--banking-txns", type=int, default=400)
+    ap.add_argument("--split", action="store_true",
+                    help="2-process split-cluster wire benchmark over "
+                         "loopback (native load on both processes)")
     ap.add_argument("--out", default="results.jsonl")
     args = ap.parse_args()
     if not (args.presets or args.orset_sweep or args.banking
-            or args.banking_wan):
+            or args.banking_wan or args.split):
         ap.error("nothing selected: pass --presets, --orset-sweep, "
-                 "--banking, and/or --banking-wan")
+                 "--banking, --banking-wan, and/or --split")
 
     import dataclasses as dc
     import time
@@ -70,6 +73,10 @@ def main() -> None:
                 cfg = dc.replace(base, wan_delay_ms=50.0,
                                  wan_jitter_ms=10.0)
                 emit(f, "banking_wan", run_banking(cfg).to_dict())
+        if args.split:
+            from janus_tpu.bench.splitbench import (SplitBenchConfig,
+                                                    run_split)
+            emit(f, "split", run_split(SplitBenchConfig()))
 
 
 if __name__ == "__main__":
