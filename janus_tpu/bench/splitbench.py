@@ -109,25 +109,22 @@ def run_split(cfg: SplitBenchConfig) -> Dict[str, object]:
                               "procs": cfg.procs,
                               "num_nodes": cfg.num_nodes}
     try:
-        ports = []
+        # the banner line is the READINESS signal only; the ports are
+        # the ones pinned in each per-proc config
+        ports = list(cports)
         for i, p in enumerate(procs):
             deadline = time.monotonic() + 300
-            port = None
-            while time.monotonic() < deadline and port is None:
+            up = False
+            while time.monotonic() < deadline and not up:
                 if p.poll() is not None:
                     raise RuntimeError(
                         "split service died during startup: "
                         + open(logs[i].name).read()[-2000:])
-                for line in open(logs[i].name).read().splitlines():
-                    if "janus-tpu service on" in line:
-                        port = int(line.split(" on ")[1]
-                                   .split()[0].rsplit(":", 1)[1])
-                        break
-                if port is None:
+                up = "janus-tpu service on" in open(logs[i].name).read()
+                if not up:
                     time.sleep(0.5)
-            if port is None:
-                raise RuntimeError("no port line from split service")
-            ports.append(port)
+            if not up:
+                raise RuntimeError("split service never became ready")
         # create keys at process 0; wait until every process's clients
         # can read them (creates replicate through the committed order)
         boot = JanusClient("127.0.0.1", ports[0], timeout=300)
