@@ -377,6 +377,16 @@ def run_tensor(cfg: BenchConfig) -> Results:
     res.extra["window"] = cfg.window  # rows are re-recorded when preset
     # geometry changes; the window disambiguates same-named rows
     res.extra["tick_ms_avg"] = round(tick_ms, 3)
+    # tick_ms_avg is max(device tick, absorb cadence): on a tunneled
+    # backend the cadence floor is ~RTT/depth, so when tick_ms_avg sits
+    # near the floor the derived values are an UPPER BOUND on the
+    # co-located latency (the chip-side bench.py decomposition is the
+    # exact reading for the flagship geometry); the floor rides along
+    # so readers can tell which regime a row is in
+    from janus_tpu.utils.perf import backend_rtt
+    obs_floor = 1e3 * backend_rtt(reps=3) / 16
+    res.extra["tick_observation_floor_ms"] = round(obs_floor, 3)
+    res.extra["derived_is_upper_bound"] = bool(tick_ms < 2 * obs_floor)
     res.extra["commit_lag_ticks_p99"] = int(np.percentile(all_lags, 99))
     res.extra["derived_colocated_p50_ms"] = round(
         float(np.percentile(all_lags, 50)) * tick_ms, 3)
@@ -634,10 +644,20 @@ def run_rga_replay(cfg: BenchConfig) -> Results:
     doc0 = jax.tree.map(lambda x: x[0], state)
     text_fn = jax.jit(lambda s: rga.text(s, 0))
     np.asarray(text_fn(doc0)["chr"])  # compile off the clock
+    from janus_tpu.utils.perf import backend_rtt
+    floor = backend_rtt(reps=3)
+    # amortize ONE fetch over 8 chained linearizations (a single-sample
+    # floor subtraction saturates at 0 when the noisy ~100 ms tunnel
+    # floor exceeds the reading; same pattern as run_tensor's reads)
     t1 = time.perf_counter()
-    out = text_fn(doc0)
+    out = None
+    for _ in range(8):
+        out = text_fn(doc0)
     np.asarray(out["chr"])
-    res.stats["get"].latencies_ms.append(1e3 * (time.perf_counter() - t1))
+    wall = time.perf_counter() - t1
+    res.stats["get"].latencies_ms.append(
+        1e3 * max(wall - floor, 0.0) / 8)
+    res.extra["linearize_fetch_floor_ms"] = round(1e3 * floor, 3)
     res.extra["applied_inserts"] = inserts + R * L  # incl. warmup tick
     res.extra["applied_deletes"] = deletes
     res.extra["compactions"] = compactions

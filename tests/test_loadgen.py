@@ -1,0 +1,42 @@
+"""Native load generator end-to-end: loadgen.cc drives a real service
+over loopback TCP and every op gets exactly one reply with a sane
+latency stamp (the wire benchmark's load side — reference
+BenchmarkRunners.cs:32-284 shape, native because the Python client caps
+at ~25k ops/s process-wide and would measure the driver)."""
+import numpy as np
+
+from janus_tpu.net import JanusClient, JanusConfig, JanusService, TypeConfig
+from janus_tpu.net.binding import NativeServer
+
+
+def test_loadgen_closed_loop_roundtrip():
+    svc = JanusService(JanusConfig(
+        num_nodes=4, window=8, ops_per_block=32, max_clients=8,
+        types=(TypeConfig("pnc", {"num_keys": 16}),)))
+    port = svc.start()
+    try:
+        pre = JanusClient("127.0.0.1", port, timeout=120)
+        for k in range(4):
+            assert pre.request("pnc", f"o{k}", "s",
+                               timeout=120)["result"] == "success"
+        elapsed, counts, lat, cls = NativeServer.loadgen_run(
+            "127.0.0.1", port, conns=2, ops_per_conn=120, pipeline=16,
+            n_keys=4, type_code="pnc", pct_get=30, pct_upd=60, seed=3)
+        # every op replied exactly once, classes partition the total
+        assert sum(counts) == 2 * 120
+        assert len(lat) == len(cls) == 2 * 120
+        assert counts[2] > 0, "no safe updates in a 10% safe mix"
+        for i in range(3):
+            assert counts[i] == int((cls == i).sum())
+        # latency stamps are positive and bounded by the run's wall time
+        assert (lat > 0).all()
+        assert float(lat.max()) <= elapsed * 1e3 + 1
+        # safe updates wait for consensus: their median exceeds the
+        # immediate-reply update median
+        assert (np.median(lat[cls == 2]) > np.median(lat[cls == 1]))
+        # the server agrees on the volume (creates + warmupless run)
+        stats = pre.request("stats", "_", "g", timeout=120)
+        assert '"ops_received"' in stats["result"]
+        pre.close()
+    finally:
+        svc.stop()
