@@ -29,6 +29,11 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+# tensor-mode driver pipeline depth for the throughput phase (the
+# latency phase runs depth 2); also sets the reported absorb-cadence
+# observation floor (~backend RTT / depth)
+DRIVE_DEPTH = 16
+
 
 @dataclasses.dataclass(frozen=True)
 class BenchConfig:
@@ -272,11 +277,12 @@ def run_tensor(cfg: BenchConfig) -> Results:
     def fetch(packed):
         return np.asarray(packed), time.perf_counter()
 
-    # default pipeline depth 16: on a tunneled backend the absorb
-    # cadence is RTT/depth, and shallow pipelines measure the tunnel
-    # (tick floor ~14 ms at depth 8 vs a ~2 ms device tick for pnc);
-    # the latency phase below still runs depth 2
-    def drive(pool, ticks, record=True, idle=False, depth=16):
+    # default pipeline depth: on a tunneled backend the absorb cadence
+    # is RTT/depth, and shallow pipelines measure the tunnel (tick
+    # floor ~14 ms at depth 8 vs a ~2 ms device tick for pnc); the
+    # latency phase below still runs depth 2. Shared with the
+    # observation-floor report so the two can't drift apart.
+    def drive(pool, ticks, record=True, idle=False, depth=DRIVE_DEPTH):
         inflight = []
         for i in range(ticks):
             for code, kv, secure in specs:
@@ -324,6 +330,13 @@ def run_tensor(cfg: BenchConfig) -> Results:
 
     import jax
 
+    from janus_tpu.utils.perf import backend_rtt
+
+    # ONE floor sample reused for the read timing and the observation-
+    # floor report below (each backend_rtt call costs reps tunnel round
+    # trips, and the two uses must describe the same quantity)
+    rtt_floor = backend_rtt(reps=3)
+
     for code, kv, _ in specs:
         lats = 1e3 * np.asarray(kv.wall_latency_log)
         res.stats["safeUpdate"].latencies_ms.extend(lats.tolist())
@@ -343,8 +356,7 @@ def run_tensor(cfg: BenchConfig) -> Results:
         # fetch floor = trivial-kernel round trip (dispatch + fetch, no
         # real read work), so subtracting it leaves the read's own
         # device time rather than 7/8 of it
-        from janus_tpu.utils.perf import backend_rtt
-        fetch_floor = backend_rtt(reps=3)
+        fetch_floor = rtt_floor
         for _ in range(10):
             t1 = time.perf_counter()
             out = None
@@ -378,15 +390,15 @@ def run_tensor(cfg: BenchConfig) -> Results:
     # geometry changes; the window disambiguates same-named rows
     res.extra["tick_ms_avg"] = round(tick_ms, 3)
     # tick_ms_avg is max(device tick, absorb cadence): on a tunneled
-    # backend the cadence floor is ~RTT/depth, so when tick_ms_avg sits
-    # near the floor the derived values are an UPPER BOUND on the
-    # co-located latency (the chip-side bench.py decomposition is the
-    # exact reading for the flagship geometry); the floor rides along
-    # so readers can tell which regime a row is in
-    from janus_tpu.utils.perf import backend_rtt
-    obs_floor = 1e3 * backend_rtt(reps=3) / 16
+    # backend the cadence floor is ~RTT/pipeline-depth (the secure path
+    # steps synchronously — effective depth 1), so when tick_ms_avg is
+    # within a few multiples of the floor the derived values are an
+    # UPPER BOUND on the co-located latency (the chip-side bench.py
+    # decomposition is the exact reading for the flagship geometry);
+    # the floor rides along so readers can tell a row's regime
+    obs_floor = 1e3 * rtt_floor / (1 if planes else DRIVE_DEPTH)
     res.extra["tick_observation_floor_ms"] = round(obs_floor, 3)
-    res.extra["derived_is_upper_bound"] = bool(tick_ms < 2 * obs_floor)
+    res.extra["derived_is_upper_bound"] = bool(tick_ms < 4 * obs_floor)
     res.extra["commit_lag_ticks_p99"] = int(np.percentile(all_lags, 99))
     res.extra["derived_colocated_p50_ms"] = round(
         float(np.percentile(all_lags, 50)) * tick_ms, 3)

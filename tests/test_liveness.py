@@ -1,0 +1,67 @@
+"""Bounded-ring liveness under crash faults: a run of dead-leader waves
+(crashed leaders whose anchors never exist) must not deadlock the ring.
+
+Measured round-5 failure this guards: with n=8, nodes {6,7} crashed, and
+seed-0 leader election, waves 6,7,8 all elect crashed leaders — a 3-wave
+dead run. A W=8 ring holds W/2=4 waves in flight; once the run spans the
+window's tail, certified-but-uncommitted blocks keep `can_gain` true,
+the GC frontier freezes (base_round stuck at 10), back-pressure rejects
+every submission, and the cluster halts forever. The reference never
+deadlocks only because its DAG grows without bound (DAG.cs GC comment
+:946-965); the bounded ring's liveness contract is W/2 > longest
+dead-leader run + 2, so fault deployments size W accordingly (the
+harness fault presets use window=16)."""
+import numpy as np
+
+from janus_tpu.consensus import DagConfig
+from janus_tpu.consensus.tusk import leader_of
+from janus_tpu.models import base, pncounter
+from janus_tpu.runtime.safecrdt import SafeKV
+
+N, B, K = 8, 8, 16
+CRASHED = 2
+
+
+def _drive(window: int, ticks: int):
+    kv = SafeKV(DagConfig(N, window), pncounter.SPEC, ops_per_block=B,
+                collect_logs=False, num_keys=K, num_writers=N)
+    rng = np.random.default_rng(0)
+    active = np.ones(N, bool)
+    active[-CRASHED:] = False
+    accepted_by_tick = []
+    for _ in range(ticks):
+        ops = base.make_op_batch(
+            op=np.where(active[:, None],
+                        rng.integers(1, 3, (N, B)), 0).astype(np.int32),
+            key=rng.integers(0, K, (N, B)).astype(np.int32),
+            a0=rng.integers(1, 10, (N, B)).astype(np.int32),
+            writer=np.broadcast_to(
+                np.arange(N, dtype=np.int32)[:, None], (N, B)).copy())
+        info = kv.step(ops, active=active, record=True)
+        accepted_by_tick.append(int(info["accepted"][:N - CRASHED].sum()))
+    return kv, accepted_by_tick
+
+
+def test_seed0_leader_mix_has_a_dead_run():
+    """The scenario premise: waves 6-8 elect crashed leaders (a 3-run)."""
+    cfg = DagConfig(N, 8)
+    dead = {N - CRASHED + i for i in range(CRASHED)}
+    leaders = [int(leader_of(cfg, w, seed=0)) for w in range(10)]
+    assert all(l in dead for l in leaders[6:9]), leaders
+
+
+def test_w8_ring_deadlocks_and_w16_survives():
+    # W=8: the 3-run spans the 4 in-flight waves -> full halt (every
+    # live submission rejected for the rest of the run)
+    kv8, acc8 = _drive(window=8, ticks=40)
+    assert acc8[-1] == 0 and acc8[-5:] == [0] * 5, acc8[-10:]
+    frozen_base = kv8.base_round()
+
+    # W=16: 8 waves in flight ride out the run; submissions keep
+    # landing, commits keep flowing, and the GC frontier passes the
+    # point where the small ring froze
+    kv16, acc16 = _drive(window=16, ticks=40)
+    assert acc16[-1] == N - CRASHED, acc16[-10:]
+    assert all(a == N - CRASHED for a in acc16[-10:])
+    assert kv16.stats["own_commits"] > kv8.stats["own_commits"]
+    assert kv16.base_round() > frozen_base
