@@ -194,3 +194,45 @@ def test_rga_churn_with_compaction():
         chars = np.asarray(out["chr"])[v][live]
         texts.append("".join(chr(int(c)) for c in chars))
     assert all(t == texts[0] for t in texts), texts
+
+
+def test_watermark_protects_live_buffered_add():
+    """The fence's counter-watermark soundness case: a tag that is
+    TOMBSTONED locally while its minting add still rides a live block
+    must survive compaction — a lagging view replaying that add into a
+    compacted (tombstone-free) row would otherwise resurrect it."""
+    import jax.numpy as jnp
+
+    st = orset.init(num_keys=2, capacity=8, rm_capacity=4)
+    # two tombstoned tags on key 0: ctr 5 (old, below any live add) and
+    # ctr 20 (minted concurrently with the live window)
+    ops = base.make_op_batch(
+        op=np.array([orset.OP_ADD, orset.OP_ADD], np.int32),
+        key=np.zeros(2, np.int32),
+        a0=np.array([7, 7], np.int32),
+        a1=np.array([0, 1], np.int32),
+        a2=np.array([5, 20], np.int32))
+    st = orset.apply_ops(st, ops)
+    rm = base.make_op_batch(op=np.array([orset.OP_CLEAR], np.int32),
+                            key=np.zeros(1, np.int32))
+    prepared = orset.prepare_ops(st, rm)
+    st = orset.apply_ops(st, prepared)
+    assert not bool(np.asarray(orset.contains(st, 0, 7)))
+
+    # live window: one buffered add with ctr 10 -> watermark 10
+    live = {f: jnp.zeros((4,), jnp.int32) for f in base.OP_FIELDS}
+    live["op"] = jnp.array([orset.OP_ADD, 0, 0, 0], jnp.int32)
+    live["a1"] = jnp.array([1, 0, 0, 0], jnp.int32)
+    live["a2"] = jnp.array([10, 0, 0, 0], jnp.int32)
+    out = orset.compact_fence(st, live)
+
+    reps = np.asarray(out["tag_rep"])[0]
+    ctrs = np.asarray(out["tag_ctr"])[0]
+    valid = np.asarray(out["valid"])[0]
+    removed = np.asarray(out["removed"])[0]
+    kept = {(int(r), int(c)) for r, c, v in zip(reps, ctrs, valid) if v}
+    # ctr 20 >= watermark 10: its add could still be in flight -> the
+    # sticky tombstone survives; ctr 5 < watermark: reclaimed
+    assert (1, 20) in kept
+    assert (0, 5) not in kept
+    assert removed[valid].all()  # everything kept is still tombstoned
