@@ -709,9 +709,15 @@ PRESETS = {
     # stays small; a small row is also what keeps the batched-union
     # record soup (state is re-sorted per delta apply) from dominating
     # the tick
+    # B=5120 is the measured throughput peak at this node count (the
+    # sweep is RECORDED as orset16_bsweep_* rows in results_r5.jsonl:
+    # 2048/3072/4096/6144 -> 85.8k/104.5k/122.2k/131.1k ops/s vs 136.2k
+    # here — the [K*C] state share of the per-tick sort amortizes with
+    # block size until the op-record share dominates); orset_light is
+    # the light-load latency geometry
     "orset": BenchConfig(name="orset_16rep", type_code="orset", num_nodes=16,
-                         window=8, num_objects=1000, ops_per_block=2048,
-                         ticks=16, orset_capacity=64, orset_rm_capacity=4,
+                         window=8, num_objects=1000, ops_per_block=5120,
+                         ticks=10, orset_capacity=64, orset_rm_capacity=4,
                          ops_ratio=(0.0, 1.0, 0.0)),
     # the reference's own OR-Set PEAK geometry (4 nodes, 100 objects,
     # 50-element cap — paper §6.2 Fig 5's 80k ops/s point); 16 nodes is

@@ -201,7 +201,6 @@ def test_watermark_protects_live_buffered_add():
     TOMBSTONED locally while its minting add still rides a live block
     must survive compaction — a lagging view replaying that add into a
     compacted (tombstone-free) row would otherwise resurrect it."""
-    import jax.numpy as jnp
 
     st = orset.init(num_keys=2, capacity=8, rm_capacity=4)
     # two tombstoned tags on key 0: ctr 5 (old, below any live add) and
@@ -220,10 +219,7 @@ def test_watermark_protects_live_buffered_add():
     assert not bool(np.asarray(orset.contains(st, 0, 7)))
 
     # live window: one buffered add with ctr 10 -> watermark 10
-    live = {f: jnp.zeros((4,), jnp.int32) for f in base.OP_FIELDS}
-    live["op"] = jnp.array([orset.OP_ADD, 0, 0, 0], jnp.int32)
-    live["a1"] = jnp.array([1, 0, 0, 0], jnp.int32)
-    live["a2"] = jnp.array([10, 0, 0, 0], jnp.int32)
+    live = base.make_op_batch(op=[orset.OP_ADD], a1=[1], a2=[10], batch=4)
     out = orset.compact_fence(st, live)
 
     reps = np.asarray(out["tag_rep"])[0]
