@@ -46,6 +46,15 @@ class BankingConfig:
     # Fig 12); set (50, 10) to reproduce that configuration
     wan_delay_ms: float = 0.0
     wan_jitter_ms: float = 0.0
+    # transactions in flight per client connection. The serial
+    # send->wait loop made the CLIENT the bottleneck (1.1k TPS while the
+    # server idled); each worker now runs `pipeline` concurrent
+    # transaction state machines over one connection, advancing
+    # whichever reply lands first (JanusClient.wait_any). 1 restores the
+    # serial loop; WAN emulation also forces it (the injected sleeps are
+    # per-request and inline, so pipelining would just serialize them
+    # dishonestly).
+    pipeline: int = 8
     seed: int = 0
 
     @classmethod
@@ -76,6 +85,7 @@ class BankingResults:
             "wan_delay_ms": self.cfg.wan_delay_ms,
             "wan_jitter_ms": self.cfg.wan_jitter_ms,
             "clients": self.cfg.clients,
+            "pipeline": self.cfg.pipeline,
             "latency": {t: s.summary() for t, s in self.stats.items()},
         }
 
@@ -143,35 +153,96 @@ def run_banking(cfg: BankingConfig) -> BankingResults:
                     cfg.wan_delay_ms, cfg.wan_jitter_ms)) / 1e3)
             return out
 
-        barrier.wait()
-        for _ in range(cfg.txns_per_client):
+        def pick_txn():
+            """Sample one transaction and fire its FIRST request;
+            returns (seq, txn state). Stages: "done" (this reply
+            completes the txn), "credit" (transfer ack -> credit the
+            destination), "check" (withdraw balance -> debit if
+            covered)."""
             r = rng.random() * sum(cfg.mix)
             src = f"acct{_account(rng, cfg)}"
             amt = int(rng.integers(1, 100))
-            t1 = time.perf_counter()
+            txn = {"t1": time.perf_counter(), "src": src, "amt": amt}
             if r < w_view:
-                req("pnc", src, "gp")
-                kind = "view"
-            elif r < w_view + w_dep:
-                req("pnc", src, "i", [str(amt)])
-                kind = "deposit"
-            elif r < w_view + w_dep + w_tr:
+                txn.update(kind="view", stage="done")
+                return c.send("pnc", src, "gp"), txn
+            if r < w_view + w_dep:
+                txn.update(kind="deposit", stage="done")
+                return c.send("pnc", src, "i", [str(amt)]), txn
+            if r < w_view + w_dep + w_tr:
                 # transfer: SAFE debit source, then credit destination
                 # (the credit is chained after the consensus ack,
                 # BankingWorload.cs transfer callback chain)
-                dst = f"acct{_account(rng, cfg)}"
-                req("pnc", src, "d", [str(amt)], is_safe=True)
-                req("pnc", dst, "i", [str(amt)])
-                kind = "transfer"
-            else:
-                # withdraw: stable read, then safe debit if covered
-                bal = int(req("pnc", src, "gs")["result"])
-                if bal >= amt:
+                txn.update(kind="transfer", stage="credit",
+                           dst=f"acct{_account(rng, cfg)}")
+                return c.send("pnc", src, "d", [str(amt)],
+                              is_safe=True), txn
+            # withdraw: stable read, then safe debit if covered
+            txn.update(kind="withdraw", stage="check")
+            return c.send("pnc", src, "gs"), txn
+
+        serial = cfg.pipeline <= 1 or cfg.wan_delay_ms > 0
+        depth = 1 if serial else cfg.pipeline
+
+        barrier.wait()
+        if serial:
+            # closed serial loop — the WAN-emulation path (inline
+            # per-request sleeps) and the pipeline=1 control
+            for _ in range(cfg.txns_per_client):
+                r = rng.random() * sum(cfg.mix)
+                src = f"acct{_account(rng, cfg)}"
+                amt = int(rng.integers(1, 100))
+                t1 = time.perf_counter()
+                if r < w_view:
+                    req("pnc", src, "gp")
+                    kind = "view"
+                elif r < w_view + w_dep:
+                    req("pnc", src, "i", [str(amt)])
+                    kind = "deposit"
+                elif r < w_view + w_dep + w_tr:
+                    dst = f"acct{_account(rng, cfg)}"
                     req("pnc", src, "d", [str(amt)], is_safe=True)
+                    req("pnc", dst, "i", [str(amt)])
+                    kind = "transfer"
                 else:
-                    failed += 1
-                kind = "withdraw"
-            local.append((kind, 1e3 * (time.perf_counter() - t1)))
+                    bal = int(req("pnc", src, "gs")["result"])
+                    if bal >= amt:
+                        req("pnc", src, "d", [str(amt)], is_safe=True)
+                    else:
+                        failed += 1
+                    kind = "withdraw"
+                local.append((kind, 1e3 * (time.perf_counter() - t1)))
+        else:
+            # `depth` transaction state machines share the connection;
+            # multi-request transactions chain their next request off
+            # whichever reply arrives first
+            inflight: Dict[int, dict] = {}
+            started = completed = 0
+            while completed < cfg.txns_per_client:
+                while (started < cfg.txns_per_client
+                       and len(inflight) < depth):
+                    seq, txn = pick_txn()
+                    inflight[seq] = txn
+                    started += 1
+                seq, rep = c.wait_any(list(inflight), timeout=120)
+                txn = inflight.pop(seq)
+                stage = txn["stage"]
+                if stage == "credit":
+                    txn["stage"] = "done"
+                    inflight[c.send("pnc", txn["dst"], "i",
+                                    [str(txn["amt"])])] = txn
+                    continue
+                if stage == "check":
+                    if int(rep["result"]) >= txn["amt"]:
+                        txn["stage"] = "done"
+                        inflight[c.send("pnc", txn["src"], "d",
+                                        [str(txn["amt"])],
+                                        is_safe=True)] = txn
+                        continue
+                    failed += 1  # overdraft declined client-side
+                local.append(
+                    (txn["kind"], 1e3 * (time.perf_counter() - txn["t1"])))
+                completed += 1
         c.close()
         with lock:
             for kind, ms in local:
@@ -201,11 +272,16 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--wan", action="store_true",
                     help="emulate the reference's WAN: 50 +/- 10 ms "
                          "per direction (paper §6.3)")
+    ap.add_argument("--pipeline", type=int, default=None,
+                    help="transactions in flight per client connection "
+                         "(1 = serial closed loop)")
     args = ap.parse_args(argv)
     cfg = (BankingConfig.from_json(open(args.config).read())
            if args.config else BankingConfig())
     if args.wan:
         cfg = dataclasses.replace(cfg, wan_delay_ms=50.0, wan_jitter_ms=10.0)
+    if args.pipeline is not None:
+        cfg = dataclasses.replace(cfg, pipeline=args.pipeline)
     res = run_banking(cfg)
     if args.json:
         print(json.dumps(res.to_dict()))

@@ -75,6 +75,16 @@ class BenchConfig:
     # stays bounded while the cumulative op log runs to millions
     rga_delete_lag: int = 2
     rga_compact_every: int = 4
+    # adaptive mode (mode="adaptive"): offered-rate drive through the
+    # AIMD block-size controller (obs/scheduler.py). ops_per_block is
+    # the throughput-peak CEILING; offered_per_tick=0 saturates (full
+    # blocks every tick), >0 trickles that many ops per node per tick.
+    # adaptive=False runs the same offered-rate drive at fixed B — the
+    # like-for-like control for the controller's latency win.
+    adaptive: bool = True
+    offered_per_tick: int = 0
+    block_floor: int = 64
+    latency_target_ms: float = 50.0
     seed: int = 0
 
     @classmethod
@@ -411,6 +421,161 @@ def run_tensor(cfg: BenchConfig) -> Results:
 
 
 # ---------------------------------------------------------------------------
+# adaptive mode
+# ---------------------------------------------------------------------------
+
+def run_tensor_adaptive(cfg: BenchConfig) -> Results:
+    """Offered-rate drive through the AIMD block-size controller: each
+    tick appends ``offered_per_tick`` ops per node to a host queue,
+    boards up to the CURRENT block size B, steps synchronously (depth 1
+    — wall latencies carry no pipeline queueing), and feeds the
+    controller backlog + measured seal latency. offered_per_tick=0
+    saturates: full blocks every tick, so the controller should hold or
+    grow B to the cfg.ops_per_block ceiling (the swept peak); a trickle
+    should shrink B to the floor and collapse the safe-update wall
+    latency the fixed-B=5120 preset pays."""
+    from janus_tpu.consensus import DagConfig
+    from janus_tpu.models import base, orset, pncounter
+    from janus_tpu.obs import AdaptiveTick, SchedulerConfig
+    from janus_tpu.obs import stages as obs_stages
+    from janus_tpu.runtime.safecrdt import SafeKV
+    from janus_tpu.utils.ids import TagMinter
+
+    res = Results(cfg)
+    rng = np.random.default_rng(cfg.seed)
+    n, K, b_max = cfg.num_nodes, cfg.num_objects, cfg.ops_per_block
+    dag = DagConfig(cfg.num_nodes, cfg.window)
+    if cfg.type_code == "pnc":
+        kv = SafeKV(dag, pncounter.SPEC, ops_per_block=b_max,
+                    collect_logs=False, num_keys=K, num_writers=n)
+    else:
+        kv = SafeKV(dag, orset.SPEC, ops_per_block=b_max,
+                    collect_logs=False, num_keys=K,
+                    apply_budget=n + max(4, n // 4),
+                    capacity=cfg.orset_capacity,
+                    rm_capacity=cfg.orset_rm_capacity)
+    minters = [TagMinter(v) for v in range(n)]
+    sched = None
+    if cfg.adaptive:
+        sched = AdaptiveTick(SchedulerConfig(
+            b_min=min(cfg.block_floor, b_max), b_max=b_max,
+            window=cfg.window, latency_target_ms=cfg.latency_target_ms,
+            grow_step=max(64, b_max // 8), adjust_every=4,
+            quantum=min(64, b_max)), b0=b_max)
+
+    cols = ("op", "key", "a0", "a1", "a2")
+    queues = [{c: np.zeros(0, np.int32) for c in cols} for _ in range(n)]
+
+    def gen_cols(v: int, count: int) -> Dict[str, np.ndarray]:
+        keys = _keys(rng, cfg, (count,))
+        if cfg.type_code == "pnc":
+            return {"op": rng.integers(pncounter.OP_INC, pncounter.OP_DEC + 1,
+                                       count).astype(np.int32),
+                    "key": keys, "a0": rng.integers(1, 10, count).astype(
+                        np.int32),
+                    "a1": np.zeros(count, np.int32),
+                    "a2": np.zeros(count, np.int32)}
+        is_add = rng.random(count) < 0.5
+        tags = np.zeros((count, 2), np.int32)
+        lanes = np.nonzero(is_add)[0]
+        if lanes.size:
+            tags[lanes] = minters[v].mint_many(lanes.size)
+        return {"op": np.where(is_add, orset.OP_ADD,
+                               orset.OP_REMOVE).astype(np.int32),
+                "key": keys,
+                "a0": rng.integers(0, 64, count).astype(np.int32),
+                "a1": tags[:, 0], "a2": tags[:, 1]}
+
+    resize_failures = [0]
+
+    def one_tick(record: bool = True) -> int:
+        B = kv.B
+        offered = cfg.offered_per_tick
+        batch = {c: np.zeros((n, B), np.int32) for c in cols}
+        batch["writer"] = np.broadcast_to(
+            np.arange(n, dtype=np.int32)[:, None], (n, B)).copy()
+        boarded = np.zeros(n, np.int64)
+        backlog = 0
+        for v in range(n):
+            if offered == 0:
+                fresh = gen_cols(v, B)
+                for c in cols:
+                    batch[c][v] = fresh[c]
+                boarded[v] = B
+                backlog = max(backlog, 2 * B)  # saturated by construction
+                continue
+            fresh = gen_cols(v, offered)
+            q = queues[v]
+            for c in cols:
+                q[c] = np.concatenate([q[c], fresh[c]])
+            take = min(B, len(q["op"]))
+            for c in cols:
+                batch[c][v, :take] = q[c][:take]
+            boarded[v] = take
+        t0 = time.perf_counter()
+        info = kv.step(base.make_op_batch(**batch),
+                       record=(np.asarray(boarded > 0) if record
+                               else False))
+        seal_s = time.perf_counter() - t0
+        acc = info["accepted"]
+        done = 0
+        for v in range(n):
+            if offered == 0:
+                done += int(boarded[v]) if acc[v] else 0
+                continue
+            q = queues[v]
+            if acc[v]:
+                take = int(boarded[v])
+                for c in cols:
+                    q[c] = q[c][take:]
+                done += take
+            backlog = max(backlog, len(q["op"]))
+        if sched is not None:
+            sched.observe(backlog, seal_s * 1e3)
+            target = sched.maybe_adjust()
+            if target is not None and target != kv.B:
+                if not kv.resize_block(target):
+                    resize_failures[0] += 1
+        return done
+
+    warmup = max(2 * cfg.window, 16)
+    for _ in range(warmup):
+        one_tick(record=False)
+    kv.wall_latency_log.clear()
+    kv.latency_log.clear()
+    b_trace = [kv.B]
+    total = 0
+    t0 = time.perf_counter()
+    for _ in range(cfg.ticks):
+        total += one_tick()
+        b_trace.append(kv.B)
+    res.elapsed_s = time.perf_counter() - t0
+    # drain: commits for the last boarded blocks land within ~W ticks
+    for _ in range(2 * cfg.window):
+        one_tick(record=False)
+
+    res.total_ops = total
+    lats = 1e3 * np.asarray(kv.wall_latency_log)
+    res.stats["safeUpdate"].latencies_ms.extend(lats.tolist())
+    res.extra["window"] = cfg.window
+    res.extra["adaptive"] = bool(cfg.adaptive)
+    res.extra["offered_per_tick"] = cfg.offered_per_tick
+    res.extra["block_ceiling"] = b_max
+    res.extra["block_floor"] = cfg.block_floor
+    res.extra["block_final"] = kv.B
+    res.extra["block_trace"] = (b_trace[:: max(1, len(b_trace) // 16)]
+                                + [b_trace[-1]])
+    res.extra["block_resizes"] = kv.stats["block_resizes"]
+    res.extra["resize_refusals"] = resize_failures[0]
+    res.extra["tick_ms_avg"] = round(
+        1e3 * res.elapsed_s / max(cfg.ticks, 1), 3)
+    # measured (not derived) per-stage decomposition from the telemetry
+    # plane — the row PERF.md's latency table cites
+    res.extra["stages"] = obs_stages.summarize_stages(kv.stage_scope)
+    return res
+
+
+# ---------------------------------------------------------------------------
 # wire mode
 # ---------------------------------------------------------------------------
 
@@ -740,6 +905,38 @@ PRESETS = {
                                ops_per_block=256, ticks=48,
                                orset_capacity=64, orset_rm_capacity=4,
                                ops_ratio=(0.0, 1.0, 0.0)),
+    # AIMD controller at the peak geometry, saturated: full blocks every
+    # tick, so B should hold the 5120 ceiling and throughput stay within
+    # 5% of the fixed-B orset row
+    "orset_adaptive": BenchConfig(name="orset_16rep_adaptive",
+                                  type_code="orset", mode="adaptive",
+                                  num_nodes=16, window=8, num_objects=1000,
+                                  ops_per_block=5120, ticks=10,
+                                  orset_capacity=64, orset_rm_capacity=4,
+                                  block_floor=64,
+                                  ops_ratio=(0.0, 1.0, 0.0)),
+    # same controller under a trickle (256 ops/node/tick, ~5% of a full
+    # block): B collapses to the floor and the measured safe-update p50
+    # must beat the fixed-B=5120 control below >= 2x
+    "orset_adaptive_light": BenchConfig(name="orset_16rep_adaptive_light",
+                                        type_code="orset", mode="adaptive",
+                                        num_nodes=16, window=8,
+                                        num_objects=1000,
+                                        ops_per_block=5120, ticks=48,
+                                        offered_per_tick=256,
+                                        orset_capacity=64,
+                                        orset_rm_capacity=4, block_floor=64,
+                                        ops_ratio=(0.0, 1.0, 0.0)),
+    # the CONTROL for the row above: identical trickle drive, controller
+    # disabled, blocks pinned at the throughput-peak 5120
+    "orset_fixed_light": BenchConfig(name="orset_16rep_fixed_light",
+                                     type_code="orset", mode="adaptive",
+                                     adaptive=False,
+                                     num_nodes=16, window=8,
+                                     num_objects=1000, ops_per_block=5120,
+                                     ticks=48, offered_per_tick=256,
+                                     orset_capacity=64, orset_rm_capacity=4,
+                                     ops_ratio=(0.0, 1.0, 0.0)),
     # 64-node two-type emulation: all 64 views' unions run on one chip,
     # so the tick is heavy — sized for a ~5-minute run
     "mixed": BenchConfig(name="mixed_zipf_64rep", type_code="mixed",
@@ -813,6 +1010,8 @@ def run(cfg: BenchConfig) -> Results:
         return run_rga_replay(cfg)
     if cfg.mode == "wire_native":
         return run_wire_native(cfg)
+    if cfg.mode == "adaptive":
+        return run_tensor_adaptive(cfg)
     return run_wire(cfg) if cfg.mode == "wire" else run_tensor(cfg)
 
 

@@ -247,6 +247,16 @@ def ingest_batch(cfg: DagConfig, state: State, seen_by,
     state tensors per frame."""
     import numpy as _np
 
+    from janus_tpu.obs.metrics import get_registry
+
+    reg = get_registry()
+    if len(blocks):
+        reg.counter("dag_wire_blocks_total").add(len(blocks))
+    if len(sigs):
+        reg.counter("dag_wire_sigs_total").add(len(sigs))
+    if len(certs):
+        reg.counter("dag_wire_certs_total").add(len(certs))
+
     out = dict(state)
     sb = jnp.asarray(seen_by)
     if len(blocks):
@@ -342,3 +352,23 @@ def round_step(cfg: DagConfig, state: State, active: Optional[jnp.ndarray] = Non
     state = deliver_certificates(cfg, state, act_mask)
     state = advance_rounds(cfg, state)
     return state
+
+
+def observe_dag(cfg: DagConfig, state: State, registry=None,
+                scope: str = "dag") -> None:
+    """Scrape-time gauges for the DAG's live shape. Fetches only the
+    small per-node/per-slot fields, so it is safe to call from a stats
+    or metrics service command without perturbing the tick loop."""
+    import numpy as np
+
+    from janus_tpu.obs.metrics import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    nr = np.asarray(state["node_round"])
+    reg.gauge(f"{scope}_base_round").set(int(np.asarray(state["base_round"])))
+    reg.gauge(f"{scope}_node_round_min").set(int(nr.min()))
+    reg.gauge(f"{scope}_node_round_max").set(int(nr.max()))
+    reg.gauge(f"{scope}_blocks_live").set(
+        int(np.asarray(state["block_exists"]).sum()))
+    reg.gauge(f"{scope}_certs_live").set(
+        int(np.asarray(state["cert_exists"]).sum()))

@@ -293,3 +293,17 @@ def order_key(cfg: DagConfig, cstate: State, base=None) -> jnp.ndarray:
     srcs = jnp.arange(n, dtype=jnp.int32)[None, None, :]
     key = cstate["commit_seq"] * (w * n) + rel * n + srcs
     return jnp.where(cstate["committed"], key, jnp.iinfo(jnp.int32).max)
+
+
+def observe_commit(cfg: DagConfig, cstate: State, registry=None,
+                   scope: str = "tusk") -> None:
+    """Scrape-time gauges for wave-commit progress (last committed wave
+    per view, live committed-block population). Stats-path only."""
+    from janus_tpu.obs.metrics import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    lw = np.asarray(cstate["last_wave"])
+    reg.gauge(f"{scope}_last_wave_min").set(int(lw.min()))
+    reg.gauge(f"{scope}_last_wave_max").set(int(lw.max()))
+    reg.gauge(f"{scope}_committed_live").set(
+        int(np.asarray(cstate["committed"]).sum()))

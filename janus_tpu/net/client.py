@@ -221,12 +221,56 @@ class JanusClient:
                 self._cv.wait(remaining)
             return self._replies.pop(seq)
 
+    def wait_any(self, seqs, timeout: Optional[float] = None):
+        """Block until a reply for ANY of ``seqs`` arrives; returns
+        ``(seq, reply)`` and leaves the others pending. The pipelining
+        primitive: a client keeps several requests in flight per
+        connection and advances whichever completes first, instead of
+        the serial send->wait->send loop that made the closed-loop
+        banking client the bottleneck."""
+        pending = set(seqs)
+        if not pending:
+            raise ValueError("wait_any of no sequences")
+        deadline = time.monotonic() + (timeout or self.timeout)
+        with self._cv:
+            while True:
+                done = pending.intersection(self._replies)
+                if done:
+                    seq = min(done)
+                    return seq, self._replies.pop(seq)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"no reply for any of {sorted(pending)}")
+                self._cv.wait(remaining)
+
     def request(self, type_code: str, key: str, op_code: str,
                 params: Iterable[str] = (), is_safe: bool = False,
                 timeout: Optional[float] = None) -> Dict[str, object]:
         """Send and block for the reply (deferred ack for safe updates)."""
         return self.wait(self.send(type_code, key, op_code, params, is_safe),
                          timeout)
+
+    # -- telemetry scrape helpers ---------------------------------------
+
+    def metrics_text(self, timeout: Optional[float] = None) -> str:
+        """Raw Prometheus text from the service's `metrics` command."""
+        rep = self.request("metrics", "_", "g", timeout=timeout)
+        if rep["response"] == "err":
+            raise RuntimeError(f"metrics scrape failed: {rep['result']}")
+        return str(rep["result"])
+
+    def scrape(self, timeout: Optional[float] = None) -> Dict[str, object]:
+        """Parsed scrape: {metric: value} with histograms folded into
+        {"buckets", "sum", "count"} dicts (obs/export.parse_prometheus)."""
+        from janus_tpu.obs.export import parse_prometheus
+        return parse_prometheus(self.metrics_text(timeout))
+
+    def stats(self, timeout: Optional[float] = None) -> Dict[str, object]:
+        """Parsed JSON from the `stats` command (includes the JSON
+        exposition of the telemetry registry under "metrics")."""
+        import json
+        return json.loads(str(
+            self.request("stats", "_", "g", timeout=timeout)["result"]))
 
     def close(self):
         self._closed = True

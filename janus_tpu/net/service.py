@@ -33,8 +33,14 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from janus_tpu.consensus import DagConfig
+from janus_tpu.consensus import dag as dagmod
+from janus_tpu.consensus import tusk
 from janus_tpu.models import base
 from janus_tpu.net.binding import INTERN_BIT, NativeServer
+from janus_tpu.obs import AdaptiveTick, SchedulerConfig
+from janus_tpu.obs import metrics as obs_metrics
+from janus_tpu.obs import stages as obs_stages
+from janus_tpu.obs.export import render_prometheus
 from janus_tpu.ops.lattice import SENTINEL
 from janus_tpu.runtime.keyspace import ReplicatedKeySpace
 from janus_tpu.runtime.safecrdt import SafeKV
@@ -80,6 +86,13 @@ class JanusConfig:
     num_nodes: int = 4
     window: int = 8
     ops_per_block: int = 16
+    # latency-adaptive block sizing (obs/scheduler.py): ops_per_block
+    # becomes the throughput-peak CEILING and the controller shrinks B
+    # toward block_floor whenever queues drain and measured seal latency
+    # exceeds block_target_ms. Off by default: fixed-B behavior.
+    adaptive_block: bool = False
+    block_floor: int = 64
+    block_target_ms: float = 50.0
     bind_addr: str = "127.0.0.1"
     port: int = 0  # 0 -> ephemeral
     max_clients: int = 64
@@ -118,6 +131,9 @@ class JanusConfig:
             num_nodes=int(raw.get("num_nodes", 4)),
             window=int(raw.get("window", 8)),
             ops_per_block=int(raw.get("ops_per_block", 16)),
+            adaptive_block=bool(raw.get("adaptive_block", False)),
+            block_floor=int(raw.get("block_floor", 64)),
+            block_target_ms=float(raw.get("block_target_ms", 50.0)),
             bind_addr=raw.get("bind_addr", "127.0.0.1"),
             port=int(raw.get("port", 0)),
             max_clients=int(raw.get("max_clients", 64)),
@@ -192,6 +208,21 @@ class _TypeRuntime:
         # device-resident zero batch for idle keep-alive rounds (rebuilt
         # host uploads every tick would ride each idle dispatch)
         self.idle_batch = None
+        # AIMD block-size controller (split mode keeps fixed B: peers
+        # would disagree on block geometry without a resize protocol)
+        self.sched = None
+        if cfg.adaptive_block and not cfg.split:
+            self.sched = AdaptiveTick(
+                SchedulerConfig(
+                    b_min=min(cfg.block_floor, cfg.ops_per_block),
+                    b_max=cfg.ops_per_block,
+                    window=cfg.window,
+                    latency_target_ms=cfg.block_target_ms,
+                    grow_step=max(64, cfg.ops_per_block // 8),
+                ),
+                b0=cfg.ops_per_block,
+                scope=f"sched_{tcfg.type_code}")
+            self.sched_target: Optional[int] = None
 
     # op-code letters for this type (e.g. {"i": 1, "d": 2})
     def op_id(self, letters: str) -> Optional[int]:
@@ -273,6 +304,10 @@ class JanusService:
                 self._fast_ops[tid] = tbl
                 self._fast_kind[tid] = tcfg.type_code
         self._stats_tid = self.server.register_type("stats", 1)
+        # Prometheus-text scrape endpoint, same in-band transport as
+        # stats (any op on the type answers with the exposition)
+        self._metrics_tid = self.server.register_type("metrics", 1)
+        self._h_ingest = obs_stages.stage_histograms("svc")["ingest"]
         # stable cross-process element ids (split mode): interned param
         # id -> hashed element id
         self._elem_cache: Dict[int, int] = {}
@@ -468,6 +503,7 @@ class JanusService:
         # poll up to one full round of blocks per step: a 4096 cap under
         # a B=8192 geometry left blocks 1/8 full while paying the full
         # device-step cost (the cap, not the device, set the ceiling)
+        t_ingest = time.perf_counter_ns()
         polled = self.server.poll_batch(
             min(65536, max(4096, n * self.cfg.ops_per_block)))
         count = len(polled["client_tag"])
@@ -513,6 +549,9 @@ class JanusService:
                 for _pos, e in lst:
                     q.append(e)
             self._stage.clear()
+        if count:
+            # measured ingest leg: wire poll -> staged on runtime queues
+            self._h_ingest.record(time.perf_counter_ns() - t_ingest)
 
         # ride pending work on each node's next block, advance one round,
         # materialize committed key creates, send deferred safe acks
@@ -555,6 +594,9 @@ class JanusService:
         home = self._homes[(tag >> 32) % len(self._homes)]
         if it["tid"] == self._stats_tid:
             self._reply(tag, self._stats_report(), "ok")
+            return
+        if it["tid"] == self._metrics_tid:
+            self._reply(tag, self._metrics_report(), "ok")
             return
         rt = self.types.get(it["tid"])
         if rt is None:
@@ -868,7 +910,9 @@ class JanusService:
         tunneled backend the split submit/tick path costs ~6 network
         round trips per step and dominates every client latency)."""
         cfg = self.cfg
-        n, B = cfg.num_nodes, cfg.ops_per_block
+        # under the adaptive controller B follows the runtime's CURRENT
+        # block capacity, not the config ceiling
+        n, B = cfg.num_nodes, rt.kv.B
         had_ops = any(rt.pending)
         if not had_ops:
             # idle keep-alive round: cached device batch, nothing
@@ -878,10 +922,12 @@ class JanusService:
                 rt.node.step(record=False)
                 return False
             import jax
-            if rt.idle_batch is None:
+            if rt.idle_batch is None or rt.idle_batch["op"].shape[1] != B:
                 rt.idle_batch = jax.device_put(base.make_op_batch(
                     op=np.zeros((n, B), np.int32)))
+            t0 = time.perf_counter()
             rt.kv.step(rt.idle_batch, record=False)
+            self._sched_update(rt, time.perf_counter() - t0)
             return False
         batch = {f: np.zeros((n, B), np.int32) for f in base.OP_FIELDS}
         safe = np.zeros((n, B), bool)
@@ -939,6 +985,7 @@ class JanusService:
             for entry in reversed(taken[v]):
                 rt.pending[v].appendleft(entry)
 
+        t_seal = time.perf_counter()
         if rt.node is not None:
             info = rt.node.step(ops, safe=safe, record=record)
             if info is None:  # key exchange incomplete: requeue all
@@ -947,6 +994,7 @@ class JanusService:
                 return had_ops
         else:
             info = rt.kv.step(ops, safe=safe, record=record)
+        self._sched_update(rt, time.perf_counter() - t_seal)
         accepted, slots = info["accepted"], info["slot"]
         for v in range(n):
             if accepted[v]:
@@ -982,6 +1030,27 @@ class JanusService:
                 # updates, DAG.cs:774-812)
                 requeue(v)
         return had_ops
+
+    def _sched_update(self, rt: _TypeRuntime, seal_sec: float) -> None:
+        """Feed the AIMD controller one tick's evidence and actuate any
+        block resize. A refused shrink (tail lanes still live) keeps the
+        target and retries next adjust — by then the ring has recycled
+        the old full-width slots."""
+        if rt.sched is None:
+            return
+        backlog = max(
+            (sum(len(e[1]["tag"]) if e[0] == "chunk" else 1 for e in q)
+             for q in rt.pending),
+            default=0)
+        rt.sched.observe(backlog, seal_sec * 1e3)
+        target = rt.sched.maybe_adjust()
+        if target is not None:
+            rt.sched_target = target
+        if rt.sched_target is not None and rt.sched_target != rt.kv.B:
+            if rt.kv.resize_block(rt.sched_target):
+                rt.idle_batch = None  # shape changed
+        if rt.sched_target == rt.kv.B:
+            rt.sched_target = None
 
     def _send_safe_acks(self, rt: _TypeRuntime):
         if not rt.ack_map:
@@ -1073,7 +1142,28 @@ class JanusService:
                 }
                 for rt in self.types.values()
             },
+            # full telemetry-plane snapshot (JSON exposition; the
+            # Prometheus text form lives on the `metrics` command)
+            "metrics": obs_metrics.get_registry().snapshot(),
         })
+
+    def _metrics_report(self) -> str:
+        """Prometheus text exposition. Scrape-time-only work happens
+        here: consensus-state gauges (small device fetches) and live
+        queue depths refresh, then the registry renders."""
+        reg = obs_metrics.get_registry()
+        for rt in self.types.values():
+            tc = rt.spec.type_code
+            dagmod.observe_dag(rt.kv.cfg, rt.kv.dag, reg, scope=f"dag_{tc}")
+            tusk.observe_commit(rt.kv.cfg, rt.kv.commit, reg,
+                                scope=f"tusk_{tc}")
+            reg.gauge(f"svc_{tc}_block_size").set(rt.kv.B)
+            reg.gauge(f"svc_{tc}_pending_ops").set(sum(
+                len(e[1]["tag"]) if e[0] == "chunk" else 1
+                for q in rt.pending for e in q))
+        reg.gauge("svc_ticks").set(self.ticks)
+        reg.gauge("svc_ops_received").set(self.server.ops_received())
+        return render_prometheus(reg)
 
 
 def main(argv=None) -> None:

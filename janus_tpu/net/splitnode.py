@@ -32,6 +32,7 @@ hazard).
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -42,8 +43,16 @@ from janus_tpu.consensus.dag import DagConfig
 from janus_tpu.models import base
 from janus_tpu.net import binding
 from janus_tpu.net.client import _read_varint, _varint, frame
+from janus_tpu.obs import stages as obs_stages
+from janus_tpu.obs.metrics import get_registry
 from janus_tpu.runtime.safecrdt import SafeKV
 from janus_tpu.utils.log import get_logger
+
+# wire-plane telemetry (process-wide): DAG-message bytes in/out and the
+# measured drain->verify->ingest leg of each step
+_C_RX_BYTES = get_registry().counter("split_rx_bytes_total")
+_C_TX_BYTES = get_registry().counter("split_tx_bytes_total")
+_H_WIRE_INGEST = obs_stages.stage_histograms("split")["ingest"]
 
 # DAG-plane subtype framing (field number = message type; CMNode.cs:81).
 # 2/3/4 existed in round 3 (structure-only); 5-7 are new.
@@ -235,6 +244,7 @@ class SplitNode:
     # -- inbound ---------------------------------------------------------
 
     def receive(self, data: bytes) -> None:
+        _C_RX_BYTES.add(len(data))
         with self._rxlock:
             self._rxbuf.extend(data)
 
@@ -562,6 +572,7 @@ class SplitNode:
         self._prev_acks = cur_acks
         self._prev_ce = prev_ce_next
         if out:
+            _C_TX_BYTES.add(len(out))
             self.send(bytes(out))
 
     def _gc_stores(self) -> None:
@@ -586,6 +597,7 @@ class SplitNode:
         or None while key exchange is incomplete. ``record`` narrows
         which nodes' blocks enter latency stats (default: all owned)."""
         acc = {"blocks": [], "sigs": [], "certs": []}
+        t_ing = _time.perf_counter_ns()
         self._drain_inbox(acc)
         if not self.ready:
             # a peer that is already ready may be sending real blocks;
@@ -598,6 +610,10 @@ class SplitNode:
             return None
         self._settle_pending(acc)
         self._ingest(acc)
+        # measured wire-ingest leg: frame parse + signature verify +
+        # batched DAG ingest for everything this step drained
+        if acc["blocks"] or acc["sigs"] or acc["certs"]:
+            _H_WIRE_INGEST.record(_time.perf_counter_ns() - t_ing)
         if ops is None:
             ops = base.make_op_batch(
                 op=np.zeros((self.cfg.num_nodes, self.B), np.int32))

@@ -1,0 +1,22 @@
+"""Telemetry plane: zero-host-sync metrics registry, safe-update stage
+timers, exposition (JSON + Prometheus text), and the latency-adaptive
+tick scheduler that consumes the measurements.
+
+The reference scatters observability across PerfCounter.cs (ops/s
+sampler), DAGStats.cs (consensus counters) and Results.cs (client-side
+latency percentiles); none of it feeds back into the protocol. This
+plane unifies them — counters/gauges/histograms in one process-wide
+registry, recorded from receive threads and the tick loop without
+device syncs or locks — and closes the loop: the AIMD block-size
+controller (obs/scheduler.py) reads the measured seal-latency histogram
+and resizes consensus blocks at runtime.
+"""
+from janus_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    get_registry,
+)
+from janus_tpu.obs.scheduler import AdaptiveTick, SchedulerConfig  # noqa: F401
+from janus_tpu.obs.stages import STAGES, stage_histograms, time_stage  # noqa: F401

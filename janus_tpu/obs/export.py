@@ -1,0 +1,107 @@
+"""Exposition: JSON snapshot and Prometheus text format (version 0.0.4).
+
+Histograms render as the standard cumulative-bucket triple
+(``_bucket{le=...}``/``_sum``/``_count``) with power-of-two ``le`` edges
+— scrape-side tooling can recover the same percentiles the in-process
+snapshot reports. ``parse_prometheus`` is the inverse used by the
+client scrape helper and the round-trip tests.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+from janus_tpu.obs.metrics import BUCKET_HI, get_registry
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize(name: str) -> str:
+    n = _NAME_RE.sub("_", name)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return n
+
+
+def snapshot_json(registry=None, extra=None) -> str:
+    """Registry snapshot as a JSON object string (merged into `stats`)."""
+    reg = registry if registry is not None else get_registry()
+    doc = {"metrics": reg.snapshot()}
+    if extra:
+        doc.update(extra)
+    return json.dumps(doc, sort_keys=True)
+
+
+def render_prometheus(registry=None) -> str:
+    """Registry in Prometheus text exposition format."""
+    reg = registry if registry is not None else get_registry()
+    lines = []
+    for name, snap in sorted(reg.snapshot().items()):
+        pname = _sanitize(name)
+        kind = snap["type"]
+        if kind == "counter":
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {snap['value']}")
+        elif kind == "gauge":
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {_fmt(snap['value'])}")
+        else:
+            lines.append(f"# TYPE {pname} histogram")
+            cum = 0
+            buckets = snap["buckets"]
+            for i, hi in enumerate(BUCKET_HI):
+                c = buckets.get(str(hi), 0)
+                if not c:
+                    continue
+                cum += c
+                lines.append(f'{pname}_bucket{{le="{hi}"}} {cum}')
+            lines.append(f'{pname}_bucket{{le="+Inf"}} {snap["count"]}')
+            lines.append(f"{pname}_sum {snap['sum']}")
+            lines.append(f"{pname}_count {snap['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{le=\"([^\"]+)\"\})?\s+(\S+)$"
+)
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse exposition text back into {name: value-or-histogram-dict}.
+
+    Histogram series are folded into one entry per metric:
+    ``{"buckets": {le: cumulative}, "sum": ..., "count": ...}``.
+    """
+    out = {}
+    types = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, le, raw = m.groups()
+        val = float(raw) if ("." in raw or raw in ("+Inf", "NaN")) else int(raw)
+        if le is not None and name.endswith("_bucket"):
+            base = name[: -len("_bucket")]
+            out.setdefault(base, {"buckets": {}, "sum": 0, "count": 0})
+            out[base]["buckets"][le] = val
+        elif name.endswith("_sum") and types.get(name[:-4]) == "histogram":
+            out.setdefault(name[:-4], {"buckets": {}, "sum": 0, "count": 0})
+            out[name[:-4]]["sum"] = val
+        elif name.endswith("_count") and types.get(name[:-6]) == "histogram":
+            out.setdefault(name[:-6], {"buckets": {}, "sum": 0, "count": 0})
+            out[name[:-6]]["count"] = val
+        else:
+            out[name] = val
+    return out

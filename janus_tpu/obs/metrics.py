@@ -1,0 +1,237 @@
+"""Process-wide metrics registry: counters, gauges, log-bucketed histograms.
+
+Design constraints, in order:
+
+1. The record path must be cheap enough to live inside the tick loop and
+   the receive threads — no locks, no allocation, no device syncs.
+   Histogram recording is a branch-free index+increment: the bucket for a
+   non-negative integer value is ``value.bit_length()`` clipped to the
+   last bucket, i.e. fixed power-of-two buckets (bucket 0 holds exactly
+   {0}; bucket i holds [2^(i-1), 2^i)). Percentiles are interpolated only
+   at scrape time.
+
+2. Concurrent recording from multiple threads must never corrupt state.
+   Plain ``list[int]`` increments under the GIL can at worst *lose* an
+   increment when two threads race the same bucket — telemetry-grade
+   loss, never corruption — which is the price of a lock-free hot path.
+
+3. The whole plane must be a leaf: this module imports nothing from the
+   rest of janus_tpu, so runtime/, consensus/, net/ and bench/ can all
+   record into it without cycles.
+"""
+from __future__ import annotations
+
+import threading
+
+NUM_BUCKETS = 64
+_MAX_IDX = NUM_BUCKETS - 1
+
+# bucket i (i >= 1) spans [2^(i-1), 2^i); upper edges for interpolation.
+BUCKET_LO = [0] + [1 << (i - 1) for i in range(1, NUM_BUCKETS)]
+BUCKET_HI = [1] + [1 << i for i in range(1, NUM_BUCKETS)]
+
+
+def bucket_index(value: int) -> int:
+    """Bucket for a value: 0 for <=0, else bit_length clipped to overflow."""
+    if value <= 0:
+        return 0
+    idx = int(value).bit_length()
+    return idx if idx < _MAX_IDX else _MAX_IDX
+
+
+class Counter:
+    """Monotonic counter. ``add`` is a single in-place increment."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+
+    def add(self, n: int = 1) -> None:
+        self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "value": self._value}
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    def add(self, n: float = 1.0) -> None:
+        self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> dict:
+        return {"type": "gauge", "value": self._value}
+
+
+class Histogram:
+    """Log-bucketed histogram of non-negative integers (default unit: ns).
+
+    64 fixed power-of-two buckets; values >= 2^62 land in the overflow
+    bucket. Recording touches one list slot and two scalars; everything
+    rank-based (percentiles, cumulative counts) happens at scrape time.
+    """
+
+    __slots__ = ("name", "unit", "_counts", "_sum", "_count")
+
+    def __init__(self, name: str, unit: str = "ns"):
+        self.name = name
+        self.unit = unit
+        self._counts = [0] * NUM_BUCKETS
+        self._sum = 0
+        self._count = 0
+
+    def record(self, value: int) -> None:
+        v = int(value)
+        if v < 0:
+            v = 0
+        idx = v.bit_length()
+        self._counts[idx if idx < _MAX_IDX else _MAX_IDX] += 1
+        self._sum += v
+        self._count += 1
+
+    def record_seconds(self, seconds: float) -> None:
+        self.record(int(seconds * 1e9))
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> int:
+        return self._sum
+
+    def counts(self) -> list:
+        return list(self._counts)
+
+    def percentile(self, q: float) -> float:
+        """Interpolated q-quantile (q in [0,1]) from bucket ranks.
+
+        Linear interpolation within the bucket containing the target
+        rank, so the result is exact for single-bucket data and bounded
+        by the bucket edges otherwise (<= 2x relative error by
+        construction of power-of-two buckets).
+        """
+        counts = list(self._counts)
+        total = sum(counts)
+        if total == 0:
+            return 0.0
+        rank = q * (total - 1)
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            # ranks [cum, cum+c-1] fall in bucket i
+            if rank < cum + c:
+                lo, hi = BUCKET_LO[i], BUCKET_HI[i]
+                if c == 1:
+                    frac = 0.5
+                else:
+                    frac = (rank - cum) / (c - 1)
+                return lo + frac * (hi - lo)
+            cum += c
+        return float(BUCKET_HI[_MAX_IDX])
+
+    def snapshot(self) -> dict:
+        counts = list(self._counts)
+        return {
+            "type": "histogram",
+            "unit": self.unit,
+            "count": self._count,
+            "sum": self._sum,
+            "p50": self.percentile(0.50),
+            "p90": self.percentile(0.90),
+            "p99": self.percentile(0.99),
+            "buckets": {
+                str(BUCKET_HI[i]): c for i, c in enumerate(counts) if c
+            },
+        }
+
+
+class Registry:
+    """Name -> instrument map. Creation is locked; recording is not.
+
+    ``enabled=False`` swaps every instrument handed out afterwards for a
+    shared no-op so instrumented code needs no feature-flag branches.
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._instruments = {}
+
+    def _get(self, name: str, cls, **kw):
+        inst = self._instruments.get(name)
+        if inst is None:
+            with self._lock:
+                inst = self._instruments.get(name)
+                if inst is None:
+                    inst = cls(name, **kw)
+                    self._instruments[name] = inst
+        if not isinstance(inst, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(inst).__name__}, requested {cls.__name__}"
+            )
+        return inst
+
+    def counter(self, name: str) -> Counter:
+        if not self.enabled:
+            return _NULL_COUNTER
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        if not self.enabled:
+            return _NULL_GAUGE
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str, unit: str = "ns") -> Histogram:
+        if not self.enabled:
+            return _NULL_HISTOGRAM
+        return self._get(name, Histogram, unit=unit)
+
+    def get(self, name: str):
+        return self._instruments.get(name)
+
+    def names(self) -> list:
+        return sorted(self._instruments)
+
+    def snapshot(self) -> dict:
+        return {
+            name: inst.snapshot()
+            for name, inst in sorted(self._instruments.items())
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+
+_NULL_COUNTER = Counter("_null")
+_NULL_GAUGE = Gauge("_null")
+_NULL_HISTOGRAM = Histogram("_null")
+
+_REGISTRY = Registry()
+
+
+def get_registry() -> Registry:
+    """The process-wide default registry."""
+    return _REGISTRY

@@ -1,0 +1,113 @@
+"""Latency-adaptive block-size controller (AIMD on measured seal latency).
+
+The round-5 sweep showed the tension the fixed presets can't resolve:
+B=5120 buys the 136.2k ops/s OR-Set peak, but a light-load safe update
+then rides a ~1 s block-fill + tick pipeline it never needed. This
+controller closes the loop using the telemetry plane's own seal-latency
+measurements:
+
+- under backlog (queues hold at least a full block), grow B additively
+  toward the swept throughput peak ``b_max``;
+- when queues drain and measured seal latency sits above the target,
+  shrink B multiplicatively toward ``b_min`` so blocks seal promptly;
+- always clamp so W x B never exceeds the ring-window back-pressure
+  bound ``max_inflight_ops`` (the DAG holds W rounds in flight; more
+  buffered ops than that can never be boarded before recycle).
+
+Actuation is decoupled from decision: ``maybe_adjust`` only returns the
+target; the owner calls ``SafeKV.resize_block`` which may refuse a
+shrink while tail lanes still carry live ops (the target is then
+retried at the next adjust tick). Blocks quantize to ``quantum`` lanes
+so XLA retraces happen at a handful of shapes, not per-adjust.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from janus_tpu.obs.metrics import get_registry
+
+
+@dataclass(frozen=True)
+class SchedulerConfig:
+    b_min: int = 64                 # latency-floor block size
+    b_max: int = 5120               # swept throughput-peak block size
+    window: int = 8                 # ring W: slots concurrently in flight
+    max_inflight_ops: int = 0       # back-pressure bound; 0 -> W * b_max
+    latency_target_ms: float = 50.0  # seal p90 the shrink path defends
+    grow_step: int = 512            # additive increase per adjust
+    shrink_factor: float = 0.5      # multiplicative decrease per adjust
+    adjust_every: int = 8           # ticks between decisions
+    quantum: int = 64               # B rounded down to a multiple
+
+    def bound(self) -> int:
+        """Largest B the ring window tolerates."""
+        cap = self.max_inflight_ops or self.window * self.b_max
+        return max(self.b_min, cap // max(1, self.window))
+
+
+class AdaptiveTick:
+    """Per-runtime AIMD controller; feed it one observation per tick."""
+
+    def __init__(self, cfg: SchedulerConfig, b0=None, scope="sched",
+                 registry=None):
+        self.cfg = cfg
+        reg = registry if registry is not None else get_registry()
+        self._g_b = reg.gauge(f"{scope}_block_size")
+        self._c_grow = reg.counter(f"{scope}_grows_total")
+        self._c_shrink = reg.counter(f"{scope}_shrinks_total")
+        start = cfg.b_max if b0 is None else int(b0)
+        self._b = self._clamp(start)
+        self._g_b.set(self._b)
+        self._ticks = 0
+        self._backlog_peak = 0
+        self._seal_ms = []
+
+    @property
+    def b(self) -> int:
+        return self._b
+
+    def _clamp(self, b: int) -> int:
+        b = min(int(b), self.cfg.b_max, self.cfg.bound())
+        b = max(b, self.cfg.b_min)
+        q = self.cfg.quantum
+        if b > q:
+            b -= b % q
+        return b
+
+    def observe(self, backlog_ops: int, seal_ms: float) -> None:
+        """One tick's evidence: deepest per-node queue, seal wall ms."""
+        self._ticks += 1
+        if backlog_ops > self._backlog_peak:
+            self._backlog_peak = int(backlog_ops)
+        self._seal_ms.append(float(seal_ms))
+
+    def maybe_adjust(self):
+        """At the adjust cadence, return a new target B (or None)."""
+        if self._ticks < self.cfg.adjust_every:
+            return None
+        backlog = self._backlog_peak
+        seal = self._seal_ms
+        self._ticks = 0
+        self._backlog_peak = 0
+        self._seal_ms = []
+        if not seal:
+            return None
+        seal_sorted = sorted(seal)
+        seal_p90 = seal_sorted[min(len(seal) - 1, int(0.9 * len(seal)))]
+        new_b = self._b
+        if backlog >= self._b:
+            # saturation: queues refill a whole block every tick
+            new_b = self._clamp(self._b + self.cfg.grow_step)
+            if new_b > self._b:
+                self._c_grow.add()
+        elif (seal_p90 > self.cfg.latency_target_ms
+              and backlog < max(1, self._b // 2)):
+            # drained and slow: blocks are bigger than the load needs
+            new_b = self._clamp(int(self._b * self.cfg.shrink_factor))
+            if new_b < self._b:
+                self._c_shrink.add()
+        if new_b == self._b:
+            return None
+        self._b = new_b
+        self._g_b.set(new_b)
+        return new_b

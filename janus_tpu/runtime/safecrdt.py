@@ -63,6 +63,7 @@ import numpy as np
 from janus_tpu.consensus import dag as dagmod
 from janus_tpu.consensus import tusk
 from janus_tpu.models import base
+from janus_tpu.obs import stages as obs_stages
 
 
 class SafeKV:
@@ -163,8 +164,15 @@ class SafeKV:
         self.stats: Dict[str, int] = {
             "ticks": 0, "blocks_submitted": 0, "own_commits": 0,
             "slots_recycled": 0, "gc_advances": 0, "state_transfers": 0,
-            "compactions": 0,
+            "compactions": 0, "block_resizes": 0,
         }
+        # measured per-stage latency histograms (seal / dag_round /
+        # commit / apply legs live here; ingest is recorded by the
+        # owning transport). Scoped by type name so a multi-type
+        # service keeps runtimes distinguishable.
+        self.stage_scope = getattr(spec, "type_code",
+                                   getattr(spec, "name", "kv"))
+        self._stage = obs_stages.stage_histograms(self.stage_scope)
         self._jit_submit = jax.jit(self._submit_device)
         self._jit_tick = jax.jit(self._tick_device)
         self._jit_step = jax.jit(self._step_device)
@@ -607,6 +615,56 @@ class SafeKV:
         self.stats["compactions"] += 1
         return True
 
+    def resize_block(self, new_b: int) -> bool:
+        """Resize the per-block op capacity B at runtime (the adaptive
+        scheduler's actuator). B is a static tensor shape — ops_buffer is
+        [W, N, B] — so resizing rebuilds the buffers and lets jax.jit
+        retrace on the new shapes (each (N, W, B) geometry compiles
+        once; the scheduler quantizes targets so only a handful of
+        shapes ever exist).
+
+        Growth zero-pads (OP_NOOP) and always succeeds. Shrink is
+        refused (returns False) while any tail lane beyond ``new_b``
+        still carries a live op or an un-recycled safe flag — the caller
+        retries at its next adjust point, by which time the ring has
+        recycled the old full-width slots."""
+        new_b = int(new_b)
+        if new_b < 1:
+            return False
+        if new_b == self.B:
+            return True
+        if new_b < self.B:
+            # one small host fetch at adjust cadence, not per tick
+            tail_ops = np.asarray(self.ops_buffer["op"])[:, :, new_b:]
+            if ((tail_ops != base.OP_NOOP).any()
+                    or self.safe_host[:, :, new_b:].any()
+                    or self.pending_safe_acks[:, :, new_b:].any()):
+                return False
+            self.ops_buffer = {
+                f: jnp.asarray(np.asarray(v)[:, :, :new_b])
+                for f, v in self.ops_buffer.items()
+            }
+            self.safe_host = np.ascontiguousarray(
+                self.safe_host[:, :, :new_b])
+            self.pending_safe_acks = np.ascontiguousarray(
+                self.pending_safe_acks[:, :, :new_b])
+        else:
+            pad = new_b - self.B
+
+            def padb(v):
+                widths = [(0, 0)] * v.ndim
+                widths[2] = (0, pad)
+                return jnp.pad(v, widths)
+
+            self.ops_buffer = {f: padb(v) for f, v in self.ops_buffer.items()}
+            self.safe_host = np.pad(
+                self.safe_host, ((0, 0), (0, 0), (0, pad)))
+            self.pending_safe_acks = np.pad(
+                self.pending_safe_acks, ((0, 0), (0, 0), (0, pad)))
+        self.B = new_b
+        self.stats["block_resizes"] += 1
+        return True
+
     # -- host API ----------------------------------------------------------
 
     def _absorb_commits(self, own: np.ndarray, rec: np.ndarray,
@@ -617,6 +675,7 @@ class SafeKV:
         here (newly-committed detection, latency logs, safe acks,
         recycled-slot resets). ``own`` is the [W, N] own-block commit
         mask; ``rec`` the [W] recycled mask."""
+        apply_t0 = time.perf_counter_ns()
         self.stats["ticks"] += 1
         self.stats["own_commits"] += int(own.sum())
         if rec.any():
@@ -628,9 +687,11 @@ class SafeKV:
             (tick_idx + 1 - self.submit_tick[newly]).tolist()
         )
         if newly.any():
-            self.wall_latency_log.extend(
-                (now - self.submit_wall[newly]).tolist()
-            )
+            walls = (now - self.submit_wall[newly]).tolist()
+            self.wall_latency_log.extend(walls)
+            h_commit = self._stage["commit"]
+            for wsec in walls:
+                h_commit.record_seconds(wsec)
         for log in (self.latency_log, self.wall_latency_log):
             if len(log) > self.max_latency_log:
                 del log[: len(log) - self.max_latency_log]
@@ -648,6 +709,7 @@ class SafeKV:
             # a GC advance is the coordination point where tombstones
             # whose ops left the window can be reclaimed
             self.maybe_compact()
+        self._stage["apply"].record(time.perf_counter_ns() - apply_t0)
         return newly
 
     def submit(self, ops: base.OpBatch, safe: Optional[np.ndarray] = None) -> np.ndarray:
@@ -676,6 +738,7 @@ class SafeKV:
         [N, W, N] mask of blocks newly committed per node view this tick
         (slot-indexed; the safe-update completion signal: a node's safe
         ops are acked when its own block commits in its own view)."""
+        tick_t0 = time.perf_counter()
         (self.prospective, self.stable, self.dag, self.commit,
          self.ops_buffer, self.buffer_filled, self.prosp_applied,
          self.stable_applied, fresh_com, seq_snap, recycled, transferred,
@@ -687,7 +750,9 @@ class SafeKV:
         self.force_transfer = lost
         self.tick_count += 1
         self._absorb_tick = self.tick_count  # keep step_absorb cursor in sync
-        fresh_com = np.asarray(fresh_com)
+        fresh_com = np.asarray(fresh_com)  # forces the round to completion
+        self._stage["dag_round"].record(
+            int((time.perf_counter() - tick_t0) * 1e9))
 
         # a transferred (crash-recovered) view adopts the donor's commit
         # history wholesale — mirror that in the host-side log, from the
@@ -790,6 +855,13 @@ class SafeKV:
         s = pre_round % w
         vs = np.arange(n)
         st = acc & rec_mask  # only payload-bearing blocks enter the stats
+        # dispatch->absorb wall = one consensus round; when payload
+        # boarded this round, the same interval is the measured
+        # block-seal leg the adaptive scheduler steers on
+        round_ns = int((now - stamp) * 1e9)
+        self._stage["dag_round"].record(round_ns)
+        if st.any():
+            self._stage["seal"].record(round_ns)
         self.stats["blocks_submitted"] += int(st.sum())
         self.submit_tick[s[st], vs[st]] = tick_idx
         self.submit_wall[s[st], vs[st]] = stamp

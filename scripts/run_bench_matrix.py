@@ -8,6 +8,15 @@ banking app, write one JSON line per run to results.jsonl.
 
     python scripts/run_bench_matrix.py --presets pnc orset rga --banking
     python scripts/run_bench_matrix.py --orset-sweep 100 1000 2000 5000
+    python scripts/run_bench_matrix.py --smoke --out /tmp/smoke.jsonl
+
+``--smoke`` runs EVERY preset once at a shrunken geometry (seconds per
+preset, not minutes) with telemetry live, and asserts the metrics
+plane's fast path costs < 2% of each run's wall clock. The overhead
+check is analytical, not an A/B wall-clock diff: (measured per-record
+cost from a microbenchmark) x (histogram records the run actually
+made) / (the run's elapsed time) — an A/B comparison at smoke
+geometry would be dominated by jit-compile jitter and flake.
 """
 from __future__ import annotations
 
@@ -17,6 +26,125 @@ import pathlib
 import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def _smoke_cfg(name, cfg):
+    """Shrink a preset to a seconds-scale geometry that still exercises
+    its distinguishing path. Constraints preserved:
+
+    - rga: every doc must take >= 1 insert per tick (the replay's
+      Lamport-counter determinism needs R*L % K == 0, L = B//2).
+    - byzantine/byzantine0: keep quorum feasibility (f byzantine needs
+      n >= 3f+1) and the W=16 ring (dead-leader liveness bound).
+    - wire modes: shrink the client fleet and per-client op counts, not
+      the node count (4 is already minimal for a quorum).
+    - the whole orset family collapses to ONE geometry (4 nodes, W8,
+      K=64, B=64, caps 64/4) so jax's jit cache compiles it once and
+      every preset after the first pays only its ticks — compile, not
+      stepping, is what makes naive shrunken presets minutes-slow.
+    """
+    import dataclasses as dc
+
+    if name == "rga":
+        # K=16 = L: each replica's lanes (v+j+t)%K cover every doc
+        # exactly once per tick, keeping the replay's deterministic
+        # Lamport ids intact (uneven coverage trips its convergence
+        # assert)
+        over = dict(num_nodes=8, num_objects=16, ops_per_block=32,
+                    ticks=6, rga_compact_every=2)
+    elif name in ("byzantine", "byzantine0"):
+        over = dict(num_nodes=8, byzantine=2, num_objects=64,
+                    ops_per_block=64, ticks=4)
+    elif cfg.mode == "wire":
+        over = dict(num_objects=32, ops_per_block=256, clients=2,
+                    ops_per_client=200, pipeline=32)
+    elif cfg.mode == "wire_native":
+        over = dict(num_objects=32, ops_per_block=256, clients=2,
+                    ops_per_client=3000, pipeline=64)
+    elif name == "mixed":
+        over = dict(num_nodes=4, num_objects=64, ops_per_block=32,
+                    ticks=2)
+    else:
+        over = dict(num_nodes=4, num_objects=min(cfg.num_objects, 64),
+                    ops_per_block=min(cfg.ops_per_block, 64),
+                    ticks=min(cfg.ticks, 4))
+        if cfg.mode == "adaptive":
+            over["block_floor"] = 32
+            over["ticks"] = 6
+            if cfg.offered_per_tick:
+                over["offered_per_tick"] = 16
+        if name in ("pnc8", "crash"):
+            # keep 8 nodes + W16: the crash pair's point is the bigger
+            # ring riding out dead-leader runs
+            over["num_nodes"] = 8
+            over["ops_per_block"] = 256
+    return dc.replace(cfg, name=cfg.name + "_smoke", **over)
+
+
+def _record_cost_ns() -> float:
+    """Measured cost of one Histogram.record on this host (the fast
+    path under test: bit_length + three in-place updates)."""
+    import time
+
+    from janus_tpu.obs.metrics import Histogram
+
+    h = Histogram("_smoke_probe")
+    n = 200_000
+    t0 = time.perf_counter_ns()
+    for v in range(n):
+        h.record(12345)
+    return (time.perf_counter_ns() - t0) / n
+
+
+def _hist_records() -> int:
+    """Total record() calls absorbed by every histogram in the default
+    registry (counter/gauge writes are per-batch, not per-record, so
+    histograms are the telemetry plane's entire per-event hot path)."""
+    from janus_tpu.obs.metrics import Histogram, get_registry
+
+    return sum(inst.count
+               for inst in get_registry()._instruments.values()
+               if isinstance(inst, Histogram))
+
+
+def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
+    import time
+
+    from janus_tpu.bench.harness import PRESETS, run
+
+    cost_ns = _record_cost_ns()
+    print(f"# per-record cost: {cost_ns:.0f} ns", flush=True)
+    failures = []
+    with open(out_path, "a") as f:
+        for name in sorted(PRESETS):
+            cfg = _smoke_cfg(name, PRESETS[name])
+            before = _hist_records()
+            t0 = time.perf_counter()
+            res = run(cfg)
+            elapsed = time.perf_counter() - t0
+            recs = _hist_records() - before
+            overhead = (recs * cost_ns) / (elapsed * 1e9)
+            payload = res.to_dict()
+            payload["smoke"] = {
+                "elapsed_s": round(elapsed, 3),
+                "hist_records": recs,
+                "record_cost_ns": round(cost_ns, 1),
+                "overhead_pct": round(100 * overhead, 4),
+            }
+            payload = {"run": f"smoke_{name}",
+                       "ts": round(time.time(), 1), **payload}
+            line = json.dumps(payload)
+            print(line, flush=True)
+            f.write(line + "\n")
+            f.flush()
+            if overhead >= overhead_budget:
+                failures.append((name, overhead))
+    if failures:
+        raise AssertionError(
+            "telemetry fast-path overhead budget exceeded: " + ", ".join(
+                f"{n}: {100 * o:.2f}%" for n, o in failures))
+    print(f"# smoke OK: {len(PRESETS)} presets, overhead < "
+          f"{100 * overhead_budget:.0f}%", flush=True)
 
 
 def main() -> None:
@@ -35,8 +163,15 @@ def main() -> None:
     ap.add_argument("--split", action="store_true",
                     help="2-process split-cluster wire benchmark over "
                          "loopback (native load on both processes)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="every preset once, shrunken geometry, "
+                         "telemetry on; asserts metrics fast-path "
+                         "overhead < 2%% of wall clock")
     ap.add_argument("--out", default="results.jsonl")
     args = ap.parse_args()
+    if args.smoke:
+        run_smoke(args.out)
+        return
     if not (args.presets or args.orset_sweep or args.banking
             or args.banking_wan or args.split):
         ap.error("nothing selected: pass --presets, --orset-sweep, "

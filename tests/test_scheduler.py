@@ -1,0 +1,157 @@
+"""AIMD block-size controller tests, plus SafeKV.resize_block actuation.
+
+The controller's contract: under a trickle with slow seals it walks B
+down to the floor; under saturation it walks B up to the ceiling; it
+never exceeds the ring-window back-pressure bound max_inflight_ops // W;
+and targets quantize so XLA sees a handful of shapes, not one per
+adjustment.
+"""
+import numpy as np
+import pytest
+
+from janus_tpu.obs.metrics import Registry
+from janus_tpu.obs.scheduler import AdaptiveTick, SchedulerConfig
+
+
+def _drive(sched, ticks, backlog, seal_ms):
+    """Feed identical observations and apply every decision."""
+    changes = []
+    for _ in range(ticks):
+        sched.observe(backlog, seal_ms)
+        t = sched.maybe_adjust()
+        if t is not None:
+            changes.append(t)
+    return changes
+
+
+def test_trickle_with_slow_seal_shrinks_to_floor():
+    cfg = SchedulerConfig(b_min=64, b_max=5120, window=8,
+                          latency_target_ms=50.0, adjust_every=2)
+    sched = AdaptiveTick(cfg, b0=5120, registry=Registry())
+    changes = _drive(sched, 40, backlog=10, seal_ms=400.0)
+    assert sched.b == 64          # at the floor...
+    assert changes[-1] == 64
+    assert all(c >= 64 for c in changes)  # ...never below it
+    # multiplicative descent: strictly decreasing targets
+    assert changes == sorted(changes, reverse=True)
+
+
+def test_fast_seal_never_shrinks():
+    cfg = SchedulerConfig(b_min=64, b_max=5120, window=8,
+                          latency_target_ms=50.0, adjust_every=2)
+    sched = AdaptiveTick(cfg, b0=1024, registry=Registry())
+    # drained queues but seals already under target: leave B alone
+    assert _drive(sched, 20, backlog=10, seal_ms=5.0) == []
+    assert sched.b == 1024
+
+
+def test_saturation_grows_to_ceiling():
+    cfg = SchedulerConfig(b_min=64, b_max=5120, window=8,
+                          grow_step=512, adjust_every=2)
+    sched = AdaptiveTick(cfg, b0=64, registry=Registry())
+    changes = _drive(sched, 60, backlog=10_000, seal_ms=5.0)
+    assert sched.b == 5120        # reached the swept peak
+    # additive ascent: strictly increasing
+    assert changes == sorted(changes)
+
+
+def test_never_exceeds_ring_window_bound():
+    # W x B must stay under max_inflight_ops: bound = 1024 // 8 = 128
+    cfg = SchedulerConfig(b_min=32, b_max=5120, window=8,
+                          max_inflight_ops=1024, quantum=32,
+                          grow_step=512, adjust_every=2)
+    sched = AdaptiveTick(cfg, b0=5120, registry=Registry())
+    assert sched.b <= 128         # clamped at construction already
+    _drive(sched, 40, backlog=10_000, seal_ms=1.0)
+    assert sched.b <= 128
+    assert sched.b * cfg.window <= cfg.max_inflight_ops
+
+
+def test_targets_quantize():
+    cfg = SchedulerConfig(b_min=64, b_max=5000, window=8, quantum=64,
+                          grow_step=500, adjust_every=2)
+    sched = AdaptiveTick(cfg, b0=64, registry=Registry())
+    changes = _drive(sched, 60, backlog=10_000, seal_ms=1.0)
+    assert changes, "controller never grew"
+    for c in changes:
+        assert c % 64 == 0
+
+
+def test_oscillation_recovers_after_load_returns():
+    cfg = SchedulerConfig(b_min=64, b_max=2048, window=8,
+                          latency_target_ms=50.0, grow_step=512,
+                          adjust_every=2)
+    sched = AdaptiveTick(cfg, b0=2048, registry=Registry())
+    _drive(sched, 30, backlog=5, seal_ms=300.0)
+    assert sched.b == 64
+    _drive(sched, 30, backlog=50_000, seal_ms=5.0)
+    assert sched.b == 2048
+
+
+# -- actuation: SafeKV.resize_block --------------------------------------
+
+@pytest.fixture(scope="module")
+def small_kv():
+    from janus_tpu.consensus import DagConfig
+    from janus_tpu.models import pncounter
+    from janus_tpu.runtime.safecrdt import SafeKV
+
+    return SafeKV(DagConfig(4, 8), pncounter.SPEC, ops_per_block=8,
+                  num_keys=8, num_writers=4)
+
+
+def _batch(kv, n_ops):
+    from janus_tpu.models import base
+
+    n, B = kv.cfg.num_nodes, kv.B
+    op = np.zeros((n, B), np.int32)
+    key = np.zeros((n, B), np.int32)
+    a0 = np.zeros((n, B), np.int32)
+    writer = np.broadcast_to(
+        np.arange(n, dtype=np.int32)[:, None], (n, B)).copy()
+    op[:, :n_ops] = kv.spec.op_codes["i"]
+    a0[:, :n_ops] = 1
+    return base.make_op_batch(op=op, key=key, a0=a0, writer=writer)
+
+
+def _prospective_sum(kv):
+    return int(np.asarray(kv.query_prospective("get")).sum())
+
+
+def test_resize_block_grow_preserves_state(small_kv):
+    kv = small_kv
+    for _ in range(4):
+        kv.step(_batch(kv, 2))
+    before = _prospective_sum(kv)
+    assert before > 0
+    assert kv.resize_block(16)
+    assert kv.B == 16
+    assert kv.ops_buffer["op"].shape[2] == 16
+    # committed/prospective state survives the geometry change
+    assert _prospective_sum(kv) == before
+    # and the runtime still steps (retraces) at the new shape
+    kv.step(_batch(kv, 3))
+    assert _prospective_sum(kv) > before
+
+
+def test_resize_block_shrink_refused_while_tail_live(small_kv):
+    kv = small_kv
+    # park ops in tail lanes (beyond the shrink target) of the current
+    # window slot, then immediately ask to shrink under them
+    kv.step(_batch(kv, kv.B))
+    b_before = kv.B
+    assert not kv.resize_block(4), (
+        "shrink must refuse while tail lanes hold live ops")
+    assert kv.B == b_before
+    # after the ring recycles those slots, the same shrink succeeds
+    for _ in range(4 * kv.cfg.num_rounds):
+        kv.step(_batch(kv, 2))
+        if kv.resize_block(4):
+            break
+    assert kv.B == 4
+    kv.step(_batch(kv, 2))  # still steps at the shrunken shape
+
+
+def test_resize_block_noop_same_size(small_kv):
+    kv = small_kv
+    assert kv.resize_block(kv.B)

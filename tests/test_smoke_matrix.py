@@ -1,0 +1,46 @@
+"""Tier-1 smoke of the full benchmark matrix with telemetry live.
+
+Runs every harness preset once at the shrunken --smoke geometry
+(scripts/run_bench_matrix.py) and holds the telemetry plane to its
+budget: the metrics fast path must cost < 2% of each run's wall clock.
+This is the regression net for "someone added an instrument inside the
+tick loop that isn't tick-loop cheap".
+"""
+import importlib.util
+import json
+import pathlib
+
+
+def _load_matrix_module():
+    path = (pathlib.Path(__file__).resolve().parent.parent
+            / "scripts" / "run_bench_matrix.py")
+    spec = importlib.util.spec_from_file_location("run_bench_matrix", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_smoke_matrix_all_presets(tmp_path):
+    from janus_tpu.bench.harness import PRESETS
+
+    mod = _load_matrix_module()
+    out = tmp_path / "smoke.jsonl"
+    # raises AssertionError itself if any preset blows the 2% budget
+    mod.run_smoke(str(out))
+
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(rows) == len(PRESETS)
+    by_run = {r["run"]: r for r in rows}
+    for name in PRESETS:
+        row = by_run[f"smoke_{name}"]
+        smoke = row["smoke"]
+        # telemetry was actually live (rga replays through jit_tick
+        # directly, not SafeKV, so it records no stage histograms) —
+        # and actually cheap
+        if name != "rga":
+            assert smoke["hist_records"] > 0, name
+        assert smoke["overhead_pct"] < 2.0, name
+    # the adaptive presets must report their controller evidence
+    adaptive = by_run["smoke_orset_adaptive"]
+    assert adaptive["block_ceiling"] >= adaptive["block_floor"]
+    assert "stages" in adaptive and "commit" in adaptive["stages"]
