@@ -75,6 +75,11 @@ class BenchConfig:
     # stays bounded while the cumulative op log runs to millions
     rga_delete_lag: int = 2
     rga_compact_every: int = 4
+    # delta-convergence mode (mode="store_delta"): union-dirty slab
+    # budget D for Store.converge_delta; the A/B workload's per-tick
+    # hot-key window derives from it (D // 2 keys), keeping the dirty
+    # fraction under budget by construction
+    dirty_budget: int = 0
     # adaptive mode (mode="adaptive"): offered-rate drive through the
     # AIMD block-size controller (obs/scheduler.py). ops_per_block is
     # the throughput-peak CEILING; offered_per_tick=0 saturates (full
@@ -576,6 +581,130 @@ def run_tensor_adaptive(cfg: BenchConfig) -> Results:
 
 
 # ---------------------------------------------------------------------------
+# store-delta mode
+# ---------------------------------------------------------------------------
+
+def run_store_delta(cfg: BenchConfig) -> Results:
+    """A/B of full vs union-dirty-slab convergence at the two-type Store
+    geometry: identical pre-generated op streams drive TWO Stores through
+    fused megaticks — one converging the whole [R, K] state every tick,
+    one converging only the dirty slab (``cfg.dirty_budget`` rows) — and
+    the final states are asserted bit-equal (delta convergence is an
+    optimization, never a semantic change; a mismatch fails the run
+    instead of faking the speedup).
+
+    The workload is the sparse-locality regime the delta path exists
+    for: each tick's keys come from a rotating hot window of
+    ``dirty_budget // 2`` keys (zipf-skewed within the window), so the
+    union-dirty count stays at ~D/2 of K keys per tick while the whole
+    keyspace is exercised over the run. Per-tick wall times (device-
+    synced) land in registry histograms; the headline is the tick-time
+    ratio at the measured dirty fraction."""
+    import jax
+
+    from janus_tpu.models import base, orset, pncounter
+    from janus_tpu.obs.metrics import get_registry
+    from janus_tpu.runtime.store import Store
+    from janus_tpu.utils.ids import TagMinter
+
+    if cfg.dirty_budget <= 0:
+        raise ValueError("store_delta mode needs dirty_budget > 0")
+    res = Results(cfg)
+    rng = np.random.default_rng(cfg.seed)
+    n, B, K = cfg.num_nodes, cfg.ops_per_block, cfg.num_objects
+    hot = min(max(1, cfg.dirty_budget // 2), K)
+    types = {
+        "pnc": dict(num_keys=K, num_writers=n),
+        "orset": dict(num_keys=K, capacity=cfg.orset_capacity,
+                      rm_capacity=cfg.orset_rm_capacity),
+    }
+    minters = [TagMinter(v) for v in range(n)]
+    writer = np.broadcast_to(np.arange(n, dtype=np.int32)[:, None], (n, B))
+
+    def gen_tick(t: int) -> Dict[str, dict]:
+        # rotate the hot window so the whole keyspace is touched over
+        # the run; zipf within the window keeps the reference's skew
+        base_key = (t * hot) % K
+        from janus_tpu.bench.workloads import zipf_keys
+        def keys():
+            local = zipf_keys(rng, hot, (n, B), cfg.zipf_theta)
+            return ((base_key + local) % K).astype(np.int32)
+        pnc_ops = base.make_op_batch(
+            op=rng.integers(pncounter.OP_INC, pncounter.OP_DEC + 1,
+                            (n, B)).astype(np.int32),
+            key=keys(), a0=rng.integers(1, 10, (n, B)), writer=writer)
+        is_add = rng.random((n, B)) < 0.5
+        tags = np.zeros((n, B, 2), np.int32)
+        for v in range(n):
+            lanes = np.nonzero(is_add[v])[0]
+            if lanes.size:
+                tags[v, lanes] = minters[v].mint_many(lanes.size)
+        or_ops = base.make_op_batch(
+            op=np.where(is_add, orset.OP_ADD,
+                        orset.OP_REMOVE).astype(np.int32),
+            key=keys(), a0=rng.integers(0, 64, (n, B)),
+            a1=tags[..., 0], a2=tags[..., 1])
+        return {"pnc": pnc_ops, "orset": or_ops}
+
+    batches = [jax.device_put(gen_tick(t)) for t in range(cfg.ticks)]
+    reg = get_registry()
+
+    def drive(store: Store, use_delta: bool, hist_name: str):
+        h = reg.histogram(hist_name)
+        times = []
+        for t, ops in enumerate(batches):
+            t0 = time.perf_counter()
+            store.fused_tick(ops, delta=use_delta)
+            jax.block_until_ready(store.states)
+            dt = time.perf_counter() - t0
+            if t > 0:  # tick 0 carries the jit compile
+                h.record_seconds(dt)
+                times.append(dt)
+        return np.asarray(times)
+
+    full = Store(n, types)
+    delta = Store(n, types, dirty_budget=cfg.dirty_budget)
+    t_full = drive(full, False, "store_full_tick")
+    t0 = time.perf_counter()
+    t_delta = drive(delta, True, "store_delta_tick")
+    res.elapsed_s = time.perf_counter() - t0
+    fracs = delta.flush_metrics()
+    full.flush_metrics()
+    # one host call (and one device program) converges every type — the
+    # final canonicalization before the exactness gate
+    full.sync_all()
+    delta.sync_all()
+
+    # bit-exactness gate: both stores saw identical op streams, so every
+    # leaf of every type must match exactly
+    for tc in types:
+        # tree.leaves orders a dict by sorted key, so pair names the same way
+        for name, a, b in zip(sorted(full.states[tc]),
+                              jax.tree.leaves(full.states[tc]),
+                              jax.tree.leaves(delta.states[tc])):
+            assert (np.asarray(a) == np.asarray(b)).all(), (
+                f"delta convergence diverged from full on {tc}.{name}")
+
+    res.total_ops = (len(batches)) * n * B * len(types)
+    med_full = float(np.median(t_full)) if t_full.size else 0.0
+    med_delta = float(np.median(t_delta)) if t_delta.size else 0.0
+    res.extra["window"] = cfg.window
+    res.extra["dirty_budget"] = cfg.dirty_budget
+    res.extra["hot_keys_per_tick"] = hot
+    res.extra["tick_ms_full_median"] = round(1e3 * med_full, 3)
+    res.extra["tick_ms_delta_median"] = round(1e3 * med_delta, 3)
+    res.extra["delta_speedup"] = round(med_full / med_delta, 2) if med_delta else 0.0
+    res.extra["dirty_fraction"] = {tc: round(f, 4) for tc, f in fracs.items()}
+    res.extra["delta_overflows"] = {
+        tc: int(reg.counter(f"store_{tc}_delta_overflow_total").value)
+        for tc in types}
+    res.extra["fused_trace_counts"] = {"full": full.fused_trace_count,
+                                       "delta": delta.fused_trace_count}
+    res.extra["states_bitequal"] = True
+    return res
+
+
+# ---------------------------------------------------------------------------
 # wire mode
 # ---------------------------------------------------------------------------
 
@@ -944,6 +1073,17 @@ PRESETS = {
                          ops_per_block=64, ticks=24, key_pattern="zipf",
                          orset_capacity=256, orset_rm_capacity=8,
                          ops_ratio=(0.3, 0.5, 0.2)),
+    # delta-convergence A/B at the mixed-64 geometry: the same two-type
+    # keyspace, driven through fused megaticks full- vs slab-converged.
+    # The hot window (dirty_budget // 2 = 32 keys/tick, zipf within) keeps
+    # the union-dirty fraction at ~6% of the 500 keys — the sparse regime
+    # where the slab join's O(D/K) cost advantage is the whole point
+    "mixed_delta": BenchConfig(name="mixed_delta_64rep", mode="store_delta",
+                               type_code="mixed", num_nodes=64, window=8,
+                               num_objects=500, ops_per_block=64, ticks=24,
+                               key_pattern="zipf", orset_capacity=256,
+                               orset_rm_capacity=8, dirty_budget=64,
+                               ops_ratio=(0.0, 1.0, 0.0)),
     # window 16: the bounded ring deadlocks if a run of dead-leader
     # waves (crashed or pruned-byzantine leaders) spans the in-flight
     # W/2 waves — the liveness bound documented at safecrdt's GC.
@@ -1012,6 +1152,8 @@ def run(cfg: BenchConfig) -> Results:
         return run_wire_native(cfg)
     if cfg.mode == "adaptive":
         return run_tensor_adaptive(cfg)
+    if cfg.mode == "store_delta":
+        return run_store_delta(cfg)
     return run_wire(cfg) if cfg.mode == "wire" else run_tensor(cfg)
 
 

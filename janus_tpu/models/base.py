@@ -70,6 +70,27 @@ def pad_op_batch(ops: OpBatch, to: int) -> OpBatch:
     return {f: jnp.pad(ops[f], (0, to - n)) for f in OP_FIELDS}
 
 
+def op_dirty_rows(ops: OpBatch, num_keys: int) -> jnp.ndarray:
+    """bool[K]: key rows touched by non-noop ops of one batch.
+
+    Every registered type routes an op's whole effect to the row
+    ``ops["key"]`` (masked by ``op != OP_NOOP``), so this scatter is the
+    exact per-batch dirty set for delta convergence: a row not marked
+    here is bit-identical to its pre-batch value."""
+    en = ops["op"] != OP_NOOP
+    return jnp.zeros((num_keys,), bool).at[ops["key"]].max(en)
+
+
+def delta_info(dirty: jnp.ndarray, slots_dropped=0) -> Dict[str, jnp.ndarray]:
+    """The uniform second return of ``apply_ops_delta``: the [K] dirty
+    mask plus a scalar count of slot records dropped by capacity
+    pressure during this apply (row_insert/upsert on a full row,
+    captured-batch records beyond C — the silent drops ISSUE 2 makes
+    countable)."""
+    return {"dirty": dirty,
+            "slots_dropped": jnp.asarray(slots_dropped, jnp.int32)}
+
+
 @dataclasses.dataclass(frozen=True)
 class CRDTTypeSpec:
     """One replicated type: its state constructor, op application, join,
@@ -93,6 +114,14 @@ class CRDTTypeSpec:
     # the tensor analog of the reference shipping full state snapshots
     # instead of operations (ReplicationManager.cs:347-357).
     op_extras: Dict[str, str | int] = dataclasses.field(default_factory=dict)
+    # Delta-state form of apply_ops: ``apply_ops_delta(state, ops) ->
+    # (state, info)`` where info = delta_info(dirty[K], slots_dropped).
+    # The dirty mask marks every key row the batch may have changed, so
+    # anti-entropy can join only those rows (runtime/store.converge_delta)
+    # — the tensor form of delta-state CRDTs (Almeida et al. 1410.2803).
+    # Must satisfy: apply_ops_delta(s, o)[0] == apply_ops(s, o), and any
+    # row outside the dirty mask is bit-identical to its input.
+    apply_ops_delta: "Callable[[Any, OpBatch], Any] | None" = None
     # dim-name defaults for op_extras resolution: a capture-width dim
     # callers may omit falls back to another dim (e.g. OR-Set
     # rm_capacity -> capacity)

@@ -95,9 +95,22 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
     at the origin and removes upsert sticky tombstone records (insert if
     absent, so late-arriving adds cannot resurrect); without capture,
     gates read the local state at apply time."""
+    return _apply_ops_impl(state, ops)[0]
+
+
+def apply_ops_delta(state: State, ops: base.OpBatch):
+    """Delta form: ``(state, delta_info)`` — [K] dirty rows + slot
+    records dropped by full vertex/edge blocks."""
+    st, dropped = _apply_ops_impl(state, ops)
+    K = state["v"].shape[-2]
+    return st, base.delta_info(base.op_dirty_rows(ops, K), dropped)
+
+
+def _apply_ops_impl(state: State, ops: base.OpBatch):
     has_capture = "ok" in ops
 
-    def step(st, op):
+    def step(carry, op):
+        st, dropped = carry
         k = op["key"]
         row = {f: st[f][k] for f in st}
         code = op["op"]
@@ -107,12 +120,14 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
         else:
             gate = _op_gates(row, code, op["a0"], op["a1"])
 
+        stats = {"slots_dropped": dropped}
+
         # -- add vertex ----------------------------------------------------
         vrow = {"elem": row["v"], "removed": row["v_removed"], "valid": row["v_valid"]}
         v_added = row_upsert(
             vrow, ("elem",), (op["a0"],), {"removed": jnp.bool_(False)},
             lambda old, new: {"removed": old["removed"]},
-            enabled=code == OP_ADD_VERTEX,
+            enabled=code == OP_ADD_VERTEX, stats=stats,
         )
 
         # -- remove vertex -------------------------------------------------
@@ -121,7 +136,7 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
             v_done = row_upsert(
                 v_added, ("elem",), (op["a0"],), {"removed": jnp.bool_(True)},
                 lambda old, new: {"removed": jnp.bool_(True)},
-                enabled=rv_ok,
+                enabled=rv_ok, stats=stats,
             )
         else:
             v_hit = row["v_valid"] & (row["v"] == op["a0"])
@@ -135,7 +150,7 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
         e_added = row_upsert(
             erow, ("src", "dst"), (op["a0"], op["a1"]), {"removed": jnp.bool_(False)},
             lambda old, new: {"removed": old["removed"]},
-            enabled=ae_ok,
+            enabled=ae_ok, stats=stats,
         )
 
         # -- remove edge ---------------------------------------------------
@@ -145,7 +160,7 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
                 e_added, ("src", "dst"), (op["a0"], op["a1"]),
                 {"removed": jnp.bool_(True)},
                 lambda old, new: {"removed": jnp.bool_(True)},
-                enabled=re_ok,
+                enabled=re_ok, stats=stats,
             )
         else:
             e_hit = (row["e_valid"] & (row["src"] == op["a0"])
@@ -160,10 +175,10 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
             "e_removed": e_done["removed"], "e_valid": e_done["valid"],
         }
         st = {f: st[f].at[k].set(out[f]) for f in st}
-        return st, None
+        return (st, stats["slots_dropped"]), None
 
-    state, _ = lax.scan(step, state, ops)
-    return state
+    (state, dropped), _ = lax.scan(step, (state, jnp.int32(0)), ops)
+    return state, dropped
 
 
 def merge(a: State, b: State) -> State:
@@ -234,5 +249,6 @@ SPEC = base.register_type(
                   "ae": OP_ADD_EDGE, "re": OP_REMOVE_EDGE},
         op_extras={"ok": 1},
         prepare_ops=prepare_ops,
+        apply_ops_delta=apply_ops_delta,
     )
 )

@@ -79,9 +79,22 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
     unconditionally (gate decided at origin); without capture, stamps only
     if the element is currently contained locally, matching the
     reference's effect-gated Remove."""
+    return _apply_ops_impl(state, ops)[0]
+
+
+def apply_ops_delta(state: State, ops: base.OpBatch):
+    """Delta form: ``(state, delta_info)`` — [K] dirty rows + slot
+    records dropped by full-row upserts."""
+    st, dropped = _apply_ops_impl(state, ops)
+    K = state["elem"].shape[-2]
+    return st, base.delta_info(base.op_dirty_rows(ops, K), dropped)
+
+
+def _apply_ops_impl(state: State, ops: base.OpBatch):
     has_capture = "ok" in ops
 
-    def step(st, op):
+    def step(carry, op):
+        st, dropped = carry
         k = op["key"]
         row = {f: st[f][k] for f in st}
         en = op["op"] != base.OP_NOOP
@@ -97,10 +110,13 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
                            row["rm_hi"], row["rm_lo"])
             )
 
+        stats = {"slots_dropped": dropped}
+
         def upsert(payload, enabled):
             return row_upsert(
                 row, KEY_FIELDS, (op["a0"],), payload,
                 lambda old, new: _combine(old, new), enabled=enabled,
+                stats=stats,
             )
 
         added = upsert(
@@ -115,10 +131,10 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
         )
         new_row = {f: jnp.where(is_add, added[f], removed[f]) for f in row}
         st = {f: st[f].at[k].set(new_row[f]) for f in st}
-        return st, None
+        return (st, stats["slots_dropped"]), None
 
-    state, _ = lax.scan(step, state, ops)
-    return state
+    (state, dropped), _ = lax.scan(step, (state, jnp.int32(0)), ops)
+    return state, dropped
 
 
 def merge(a: State, b: State) -> State:
@@ -157,5 +173,6 @@ SPEC = base.register_type(
         op_codes={"a": OP_ADD, "r": OP_REMOVE},
         op_extras={"ok": 1},
         prepare_ops=prepare_ops,
+        apply_ops_delta=apply_ops_delta,
     )
 )

@@ -67,14 +67,13 @@ def prepare_ops(state: State, ops: base.OpBatch) -> base.OpBatch:
 def _row_join(row, val, clock, enabled):
     """Join one key row with a singleton (val, clock) write — the same
     frontier rule as ``merge``, reusing merge_with_stats with a
-    capacity-1 singleton state."""
+    capacity-1 singleton state. Returns (joined, overflow)."""
     single = {
         "val": jnp.asarray(val)[None],
         "valid": jnp.asarray(enabled)[None],
         "clock": clock[None, :],
     }
-    joined, _ = merge_with_stats(row, single)
-    return joined
+    return merge_with_stats(row, single)
 
 
 def apply_ops(state: State, ops: base.OpBatch) -> State:
@@ -87,22 +86,36 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
     the write observes every locally-live value (clock = max over live
     slots, own lane + 1) and replaces the value set — the reference's
     Write semantics (MVRegister.cs:108-114)."""
+    return _apply_ops_impl(state, ops)[0]
+
+
+def apply_ops_delta(state: State, ops: base.OpBatch):
+    """Delta form: ``(state, delta_info)`` — [K] dirty rows + concurrent
+    values dropped when a row's frontier overflows capacity."""
+    st, dropped = _apply_ops_impl(state, ops)
+    K = state["val"].shape[-2]
+    return st, base.delta_info(base.op_dirty_rows(ops, K), dropped)
+
+
+def _apply_ops_impl(state: State, ops: base.OpBatch):
     has_capture = "wclock" in ops
 
-    def step(st, op):
+    def step(carry, op):
+        st, dropped = carry
         k = op["key"]
         en = op["op"] == OP_WRITE
         vcap, w = st["clock"].shape[-2:]
         if has_capture:
             row = {"val": st["val"][k], "valid": st["valid"][k],
                    "clock": st["clock"][k]}
-            joined = _row_join(row, op["a0"], op["wclock"], en)
+            joined, ovf = _row_join(row, op["a0"], op["wclock"], en)
+            dropped = dropped + jnp.where(en, ovf, 0).astype(jnp.int32)
             st = {
                 "val": st["val"].at[k].set(jnp.where(en, joined["val"], row["val"])),
                 "valid": st["valid"].at[k].set(jnp.where(en, joined["valid"], row["valid"])),
                 "clock": st["clock"].at[k].set(jnp.where(en, joined["clock"], row["clock"])),
             }
-            return st, None
+            return (st, dropped), None
         live = st["valid"][k][:, None]  # [V, 1]
         observed = jnp.max(jnp.where(live, st["clock"][k], 0), axis=0)  # [W]
         new_clock = observed.at[op["writer"]].add(1)
@@ -116,10 +129,10 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
             "valid": st["valid"].at[k].set(jnp.where(en, valid_row, st["valid"][k])),
             "clock": st["clock"].at[k].set(jnp.where(en, clock_row, st["clock"][k])),
         }
-        return st, None
+        return (st, dropped), None
 
-    state, _ = lax.scan(step, state, ops)
-    return state
+    (state, dropped), _ = lax.scan(step, (state, jnp.int32(0)), ops)
+    return state, dropped
 
 
 def merge(a: State, b: State) -> State:
@@ -207,5 +220,6 @@ SPEC = base.register_type(
         op_codes={"w": OP_WRITE},
         op_extras={"wclock": "num_writers"},
         prepare_ops=prepare_ops,
+        apply_ops_delta=apply_ops_delta,
     )
 )

@@ -227,7 +227,9 @@ def _apply_captured_batch(state: State, ops: base.OpBatch) -> State:
     Cost: one sort of K*C + B*(C+1) records — the consensus delta-apply
     hot path (a budget of blocks x B ops per tick would otherwise run
     thousands of small sequential sorts). Slots beyond a key's capacity
-    are dropped silently, like row_insert on a full row."""
+    are dropped, like row_insert on a full row; returns
+    ``(state, dropped)`` with the drop count so runtimes can surface it
+    (the obs ``slots_dropped`` counter)."""
     K, C = state["elem"].shape[-2], state["elem"].shape[-1]
     B = ops["op"].shape[0]
     R = ops["rm_rep"].shape[-1]  # capture width (rm_capacity)
@@ -335,6 +337,7 @@ def _apply_captured_batch(state: State, ops: base.OpBatch) -> State:
     pos = lo[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]  # [K, C]
     out_valid = pos < hi[:, None]  # kept-per-key <= C by the ok cap
     pos = jnp.clip(pos, 0, T - 1)
+    dropped = jnp.sum((keep & ~ok).astype(jnp.int32))
     return {
         "tag_rep": jnp.where(out_valid, crep[pos], SENTINEL),
         "tag_ctr": jnp.where(out_valid, cctr[pos], SENTINEL),
@@ -342,7 +345,7 @@ def _apply_captured_batch(state: State, ops: base.OpBatch) -> State:
         "removed": out_valid & crm[pos],
         "valid": out_valid,
         "_rm_cap": state["_rm_cap"],
-    }
+    }, dropped
 
 
 def apply_ops(state: State, ops: base.OpBatch) -> State:
@@ -366,11 +369,25 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
     Every path returns the CANONICAL row layout (see _canonical), so
     origin-applied and replay-applied states are bit-comparable.
     """
+    return _apply_ops_impl(state, ops)[0]
+
+
+def apply_ops_delta(state: State, ops: base.OpBatch):
+    """Delta form of apply_ops: ``(state, delta_info)`` with the [K]
+    dirty-row mask and the count of slot records dropped by capacity
+    pressure (full-row eviction / captured records beyond C)."""
+    st, dropped = _apply_ops_impl(state, ops)
+    K = state["elem"].shape[-2]
+    return st, base.delta_info(base.op_dirty_rows(ops, K), dropped)
+
+
+def _apply_ops_impl(state: State, ops: base.OpBatch):
     has_capture = "rm_rep" in ops
     if has_capture and int(ops["op"].shape[0]) > 1:
         return _apply_captured_batch(state, ops)
 
-    def step(st, op):
+    def step(carry, op):
+        st, dropped = carry
         k = op["key"]
         row = {f: st[f][k] for f in st if f != "_rm_cap"}
         en = op["op"] != base.OP_NOOP
@@ -387,6 +404,10 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
         # diverge replicas permanently on the first full row).
         do_add = en & (op["op"] == OP_ADD)
         found, fidx = row_find(row, KEY_FIELDS, (op["a1"], op["a2"]))
+        # keep-smallest eviction: appending into a full row drops one
+        # record (possibly the newcomer) — count it
+        dropped = dropped + (
+            do_add & ~found & jnp.all(row["valid"])).astype(jnp.int32)
         folded = dict(row)
         folded["elem"] = row["elem"].at[fidx].set(op["a0"])
         appended = {
@@ -416,8 +437,9 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
                 "removed": jnp.ones_like(op["rm_rep"], bool),
             }
             capn = added["tag_rep"].shape[-1]
-            merged, _ = slot_union(added, cap, KEY_FIELDS, _combine,
-                                   capacity=capn)
+            merged, ovf = slot_union(added, cap, KEY_FIELDS, _combine,
+                                     capacity=capn)
+            dropped = dropped + jnp.where(is_tomb, ovf, 0).astype(jnp.int32)
             new_row = {
                 f: jnp.where(is_tomb, merged[f], added[f]) for f in row
             }
@@ -437,10 +459,10 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
         new_row = _canonical_row(new_row)
         st = {f: (st[f] if f == "_rm_cap" else st[f].at[k].set(new_row[f]))
               for f in st}
-        return st, None
+        return (st, dropped), None
 
-    state, _ = lax.scan(step, state, ops)
-    return state
+    (state, dropped), _ = lax.scan(step, (state, jnp.int32(0)), ops)
+    return state, dropped
 
 
 def merge(a: State, b: State) -> State:
@@ -562,6 +584,7 @@ SPEC = base.register_type(
         dim_defaults={"rm_capacity": "capacity"},
         prepare_ops=prepare_ops,
         prepare_ops_batch=prepare_ops_batch,
+        apply_ops_delta=apply_ops_delta,
         compact_fence=compact_fence,
     )
 )

@@ -50,6 +50,13 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
     }
 
 
+def apply_ops_delta(state: State, ops: base.OpBatch):
+    """Delta form: apply + the [K] dirty mask (rows this batch scattered
+    into). A counter has no slot capacity, so nothing can drop."""
+    K = state["p"].shape[-2]
+    return apply_ops(state, ops), base.delta_info(base.op_dirty_rows(ops, K))
+
+
 def merge(a: State, b: State) -> State:
     """Lattice join: elementwise max of both polarities."""
     return {"p": join_max(a["p"], b["p"]), "n": join_max(a["n"], b["n"])}
@@ -75,5 +82,6 @@ SPEC = base.register_type(
         # scatter-add of shipped amounts: order-insensitive, reads no
         # local state -> replay-safe without capture
         replay_safe=True,
+        apply_ops_delta=apply_ops_delta,
     )
 )

@@ -106,9 +106,22 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
     """Apply insert/delete ops sequentially (lax.scan over the batch —
     inserts allocate slots and counters, so intra-batch order matters,
     like every slot type)."""
+    return _apply_ops_impl(state, ops)[0]
+
+
+def apply_ops_delta(state: State, ops: base.OpBatch):
+    """Delta form: ``(state, delta_info)`` — [K] dirty docs + slot
+    records dropped by full element blocks."""
+    st, dropped = _apply_ops_impl(state, ops)
+    K = state["id_ctr"].shape[-2]
+    return st, base.delta_info(base.op_dirty_rows(ops, K), dropped)
+
+
+def _apply_ops_impl(state: State, ops: base.OpBatch):
     has_capture = "eff_ctr" in ops
 
-    def step(st, op):
+    def step(carry, op):
+        st, dropped = carry
         k = op["key"]
         row = {f: st[f][k] for f in st if f not in _META}
         en = op["op"] != base.OP_NOOP
@@ -124,6 +137,7 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
                 jnp.max(jnp.where(row["valid"], row["id_ctr"], 0)),
                 st["ctr_floor"][k]) + 1
 
+        stats = {"slots_dropped": dropped}
         inserted = row_upsert(
             row, KEY_FIELDS, (ctr, op["writer"]),
             {"par_rep": op["a1"], "par_ctr": op["a2"],
@@ -136,7 +150,7 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
                 "chr": jnp.maximum(old["chr"], new["chr"]),
                 "dead": old["dead"],
             },
-            enabled=is_ins,
+            enabled=is_ins, stats=stats,
         )
         # delete: tombstone-record upsert — if the target id is not yet
         # present (delete replayed before its insert), a dead placeholder
@@ -149,7 +163,7 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
                 "par_rep": old["par_rep"], "par_ctr": old["par_ctr"],
                 "chr": old["chr"], "dead": jnp.bool_(True),
             },
-            enabled=is_del,
+            enabled=is_del, stats=stats,
         )
         # floor advances with every counter this op carries (insert's
         # minted ctr; delete's target ctr is an observed one, so folding
@@ -160,11 +174,11 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
         st = {f: (st[f] if f in _META else st[f].at[k].set(deleted[f]))
               for f in st}
         st["ctr_floor"] = new_floor
-        return st, None
+        return (st, stats["slots_dropped"]), None
 
-    state, _ = lax.scan(
-        step, state, {f: v for f, v in ops.items()})
-    return state
+    (state, dropped), _ = lax.scan(
+        step, (state, jnp.int32(0)), {f: v for f, v in ops.items()})
+    return state, dropped
 
 
 def merge(a: State, b: State) -> State:
@@ -339,5 +353,6 @@ SPEC = base.register_type(
         op_extras={"eff_ctr": 1},
         prepare_ops=prepare_ops,
         compact_fence=compact_fence,
+        apply_ops_delta=apply_ops_delta,
     )
 )

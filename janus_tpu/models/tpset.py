@@ -61,26 +61,40 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
     captured ``ok`` flag, upserts a sticky tombstone record (insert if
     absent, so a late-arriving add cannot resurrect); without capture
     (host-direct use), tombstones only when currently added."""
+    return _apply_ops_impl(state, ops)[0]
+
+
+def apply_ops_delta(state: State, ops: base.OpBatch):
+    """Delta form: ``(state, delta_info)`` — [K] dirty rows + slot
+    records dropped by full-row upserts."""
+    st, dropped = _apply_ops_impl(state, ops)
+    K = state["elem"].shape[-2]
+    return st, base.delta_info(base.op_dirty_rows(ops, K), dropped)
+
+
+def _apply_ops_impl(state: State, ops: base.OpBatch):
     has_capture = "ok" in ops
 
-    def step(st, op):
+    def step(carry, op):
+        st, dropped = carry
         k = op["key"]
         row = {f: st[f][k] for f in st}
         en = op["op"] != base.OP_NOOP
         is_add = en & (op["op"] == OP_ADD)
         is_rm = en & (op["op"] == OP_REMOVE)
 
+        stats = {"slots_dropped": dropped}
         added = row_upsert(
             row, KEY_FIELDS, (op["a0"],), {"removed": jnp.bool_(False)},
             # existing slot: keep its tombstone (no resurrect)
             lambda old, new: {"removed": old["removed"]},
-            enabled=is_add,
+            enabled=is_add, stats=stats,
         )
         if has_capture:
             out = row_upsert(
                 added, KEY_FIELDS, (op["a0"],), {"removed": jnp.bool_(True)},
                 lambda old, new: {"removed": jnp.bool_(True)},
-                enabled=is_rm & (op["ok"][0] != 0),
+                enabled=is_rm & (op["ok"][0] != 0), stats=stats,
             )
         else:
             hit = row["valid"] & (row["elem"] == op["a0"])
@@ -89,10 +103,10 @@ def apply_ops(state: State, ops: base.OpBatch) -> State:
             out = {f: added[f] for f in row}
             out["removed"] = added["removed"] | tomb
         st = {f: st[f].at[k].set(out[f]) for f in st}
-        return st, None
+        return (st, stats["slots_dropped"]), None
 
-    state, _ = lax.scan(step, state, ops)
-    return state
+    (state, dropped), _ = lax.scan(step, (state, jnp.int32(0)), ops)
+    return state, dropped
 
 
 def merge(a: State, b: State) -> State:
@@ -126,5 +140,6 @@ SPEC = base.register_type(
         op_codes={"a": OP_ADD, "r": OP_REMOVE},
         op_extras={"ok": 1},
         prepare_ops=prepare_ops,
+        apply_ops_delta=apply_ops_delta,
     )
 )

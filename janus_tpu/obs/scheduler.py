@@ -61,6 +61,8 @@ class AdaptiveTick:
         self._ticks = 0
         self._backlog_peak = 0
         self._seal_ms = []
+        self._overflows = 0
+        self._dirty_fracs = []
 
     @property
     def b(self) -> int:
@@ -81,27 +83,45 @@ class AdaptiveTick:
             self._backlog_peak = int(backlog_ops)
         self._seal_ms.append(float(seal_ms))
 
+    def observe_delta(self, dirty_fraction: float, overflowed: bool) -> None:
+        """Delta-converge evidence for the same tick: the union-dirty
+        fraction and whether the slab budget overflowed (forcing a full
+        converge). Overflow is shrink pressure — smaller blocks dirty
+        fewer rows per tick, pulling the delta path back under budget."""
+        self._dirty_fracs.append(float(dirty_fraction))
+        if overflowed:
+            self._overflows += 1
+
     def maybe_adjust(self):
         """At the adjust cadence, return a new target B (or None)."""
         if self._ticks < self.cfg.adjust_every:
             return None
         backlog = self._backlog_peak
         seal = self._seal_ms
+        overflows = self._overflows
+        n_delta = len(self._dirty_fracs)
         self._ticks = 0
         self._backlog_peak = 0
         self._seal_ms = []
+        self._overflows = 0
+        self._dirty_fracs = []
         if not seal:
             return None
         seal_sorted = sorted(seal)
         seal_p90 = seal_sorted[min(len(seal) - 1, int(0.9 * len(seal)))]
+        # Overflowing the dirty budget on most delta ticks means the full
+        # [R, K] converge ran anyway — the block is dirtying more rows than
+        # the slab can carry, so treat it like missed latency.
+        overflow_pressure = n_delta > 0 and overflows * 2 > n_delta
         new_b = self._b
-        if backlog >= self._b:
+        if backlog >= self._b and not overflow_pressure:
             # saturation: queues refill a whole block every tick
             new_b = self._clamp(self._b + self.cfg.grow_step)
             if new_b > self._b:
                 self._c_grow.add()
-        elif (seal_p90 > self.cfg.latency_target_ms
-              and backlog < max(1, self._b // 2)):
+        elif overflow_pressure or (
+                seal_p90 > self.cfg.latency_target_ms
+                and backlog < max(1, self._b // 2)):
             # drained and slow: blocks are bigger than the load needs
             new_b = self._clamp(int(self._b * self.cfg.shrink_factor))
             if new_b < self._b:

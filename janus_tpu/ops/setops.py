@@ -166,11 +166,21 @@ def row_first_free(row: Slots):
     return jnp.any(free), jnp.argmax(free).astype(jnp.int32)
 
 
-def row_insert(row: Slots, values: Dict[str, jnp.ndarray], enabled=True):
-    """Insert a slot into the first free position (drops silently when
-    full — callers track overflow via capacity headroom stats)."""
+def row_insert(row: Slots, values: Dict[str, jnp.ndarray], enabled=True,
+               stats: Dict[str, jnp.ndarray] | None = None):
+    """Insert a slot into the first free position. Drops when full; a
+    caller that passes a ``stats`` dict gets the drop accumulated
+    device-side into ``stats["slots_dropped"]`` (an int32 scalar it
+    threads through its scan carry and surfaces to the obs metrics
+    registry after fetch) — without one the drop stays silent, as
+    before."""
     has_free, idx = row_first_free(row)
-    do = jnp.asarray(enabled) & has_free
+    en = jnp.asarray(enabled)
+    do = en & has_free
+    if stats is not None:
+        stats["slots_dropped"] = (
+            stats.get("slots_dropped", jnp.int32(0))
+            + (en & ~has_free).astype(jnp.int32))
     out = dict(row)
     for f, v in values.items():
         out[f] = jnp.where(do, row[f].at[idx].set(v), row[f])
@@ -185,14 +195,23 @@ def row_upsert(
     values: Dict[str, jnp.ndarray],
     combine_existing: Callable[[Dict, Dict], Dict],
     enabled=True,
+    stats: Dict[str, jnp.ndarray] | None = None,
 ):
     """Insert a key or fold ``values`` into its existing slot.
 
     ``combine_existing(old_payload, new_payload) -> payload`` decides the
     update for an existing key (e.g. timestamp max for LWW adds).
+    ``stats`` (optional) accumulates ``slots_dropped`` like row_insert —
+    here a drop is an enabled upsert of an ABSENT key into a full row
+    (folding into an existing slot never drops).
     """
     found, idx = row_find(row, key_fields, key_vals)
     en = jnp.asarray(enabled)
+    if stats is not None:
+        has_free, _ = row_first_free(row)
+        stats["slots_dropped"] = (
+            stats.get("slots_dropped", jnp.int32(0))
+            + (en & ~found & ~has_free).astype(jnp.int32))
 
     # Path 1: fold into existing slot.
     old = {f: row[f][idx] for f in row if f != "valid" and f not in key_fields}
@@ -230,6 +249,11 @@ def mark_members(
     k1a, k2a = a_keys
     k1b, k2b = b_keys
     m, t = k1a.shape[0], k1b.shape[0]
+    # degenerate static shapes: no A records -> nothing to mark; no B
+    # records -> nothing can match (the cumsum/segment machinery below
+    # assumes total >= 1 — seg_end would index an empty csum)
+    if m == 0 or t == 0:
+        return jnp.zeros((m,), bool)
     total = m + t
     k1 = jnp.concatenate([k1a, jnp.where(b_valid, k1b, SENTINEL)])
     k2 = jnp.concatenate([k2a, jnp.where(b_valid, k2b, SENTINEL)])

@@ -22,7 +22,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from janus_tpu.models import base
-from janus_tpu.runtime.engine import make_tick
+from janus_tpu.runtime.engine import make_delta_tick, make_tick
 
 
 def make_mesh(replica_shards: int, key_shards: int = 1, devices=None) -> Mesh:
@@ -65,4 +65,40 @@ def sharded_tick(spec: base.CRDTTypeSpec, mesh: Mesh, state: Any, ops: base.OpBa
         make_tick(spec),
         in_shardings=(state_sharding(mesh, state), ops_sharding(mesh, ops)),
         out_shardings=state_sharding(mesh, state),
+    )
+
+
+def dirty_sharding(mesh: Mesh):
+    """Dirty masks [R, K] shard like state rows: (replica, key)."""
+    return NamedSharding(mesh, P("replica", "key"))
+
+
+def slab_sharding(mesh: Mesh, slab: Any):
+    """Gathered dirty slabs [R, D, ...] shard over replica ONLY: the
+    union-dirty gather crosses key shards (idx spans the whole key axis),
+    so the compact slab replicates along ``key`` — D is small by design,
+    and keeping it unsharded lets the tree-reduce butterfly run without
+    a resharding collective per round."""
+
+    def spec_for(x):
+        if x.ndim >= 1:
+            return NamedSharding(mesh, P("replica"))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(spec_for, slab)
+
+
+def sharded_delta_tick(spec: base.CRDTTypeSpec, mesh: Mesh, state: Any,
+                       ops: base.OpBatch, budget: int):
+    """Jitted delta tick (apply + union-dirty slab converge) with explicit
+    shardings: state in/out stays (replica, key)-sharded; XLA moves the
+    [R, D, ...] slab through an all-gather over ``key`` at the dirty
+    gather and a scatter back — the only cross-shard traffic the delta
+    path pays, proportional to D rather than K."""
+    st_shard = state_sharding(mesh, state)
+    return jax.jit(
+        make_delta_tick(spec, budget),
+        in_shardings=(st_shard, ops_sharding(mesh, ops)),
+        out_shardings=(st_shard, NamedSharding(mesh, P()),
+                       NamedSharding(mesh, P()), NamedSharding(mesh, P())),
     )

@@ -21,7 +21,8 @@ from typing import Any
 import jax
 
 from janus_tpu.models import base
-from janus_tpu.runtime.store import apply_replica_ops, converge
+from janus_tpu.runtime.store import (
+    apply_replica_ops, apply_replica_ops_delta, converge, converge_delta)
 
 
 def make_tick(spec: base.CRDTTypeSpec):
@@ -43,7 +44,30 @@ def make_local_tick(spec: base.CRDTTypeSpec):
     return tick
 
 
+def make_delta_tick(spec: base.CRDTTypeSpec, budget: int):
+    """Delta-converged tick: apply with dirty tracking, then join only the
+    union-dirty key slab (``store.converge_delta``; counted full-converge
+    fallback past ``budget`` rows). Returns
+    ``(state, overflowed, dirty_count, slots_dropped)`` — feed the last
+    three to the telemetry plane / AIMD scheduler."""
+    if spec.apply_ops_delta is None:
+        raise ValueError(f"{spec.name} has no apply_ops_delta capability")
+
+    def tick(state: Any, ops: base.OpBatch):
+        st, dirty, dropped = apply_replica_ops_delta(spec, state, ops)
+        st, overflowed, count = converge_delta(spec, st, dirty, budget)
+        return st, overflowed, count, dropped
+
+    return tick
+
+
 def jit_tick(spec: base.CRDTTypeSpec, donate: bool = True):
     """Jitted tick with state donation (the state tensor is rewritten every
     tick; donation keeps HBM at one copy)."""
     return jax.jit(make_tick(spec), donate_argnums=(0,) if donate else ())
+
+
+def jit_delta_tick(spec: base.CRDTTypeSpec, budget: int, donate: bool = True):
+    """Jitted delta tick with state donation (see ``jit_tick``)."""
+    return jax.jit(make_delta_tick(spec, budget),
+                   donate_argnums=(0,) if donate else ())

@@ -64,6 +64,7 @@ from janus_tpu.consensus import dag as dagmod
 from janus_tpu.consensus import tusk
 from janus_tpu.models import base
 from janus_tpu.obs import stages as obs_stages
+from janus_tpu.obs.metrics import get_registry
 
 
 class SafeKV:
@@ -164,7 +165,7 @@ class SafeKV:
         self.stats: Dict[str, int] = {
             "ticks": 0, "blocks_submitted": 0, "own_commits": 0,
             "slots_recycled": 0, "gc_advances": 0, "state_transfers": 0,
-            "compactions": 0, "block_resizes": 0,
+            "compactions": 0, "block_resizes": 0, "slots_dropped": 0,
         }
         # measured per-stage latency histograms (seal / dag_round /
         # commit / apply legs live here; ingest is recorded by the
@@ -270,13 +271,18 @@ class SafeKV:
 
     def _delta_apply(self, state, ops_buffer, select, order_key):
         """Apply the op batches of selected blocks, lowest key first,
-        bounded by apply_budget; returns (state, applied_mask).
+        bounded by apply_budget; returns (state, applied_mask, dropped).
 
         select/order_key: [N_view, W, N]. Up to ``apply_budget`` blocks
         per view apply this tick; the rest keep their select bit clear
         and spill to the next tick (order is irrelevant for state —
         replay-safe ops commute — but ordered selection keeps ack
-        bookkeeping and budget spill deterministic)."""
+        bookkeeping and budget spill deterministic).
+
+        ``dropped`` is the total slot records silently lost to capacity
+        pressure across the applied batches (summed over views; 0 for
+        types without apply_ops_delta) — surfaced per tick through the
+        packed output / stats so capacity starvation is observable."""
         cfg = self.cfg
         w, n = cfg.num_rounds, cfg.num_nodes
         a = min(self.apply_budget, w * n)
@@ -284,6 +290,7 @@ class SafeKV:
         flat_ops = {
             f: v.reshape((w * n,) + v.shape[2:]) for f, v in ops_buffer.items()
         }
+        has_delta = self.spec.apply_ops_delta is not None
 
         def one_view(st, sel, key):
             k = jnp.where(sel, key, inf).reshape(w * n)
@@ -295,13 +302,19 @@ class SafeKV:
                 f: v.reshape((a * self.B,) + v.shape[2:])
                 for f, v in rows.items()
             }
-            st = self.spec.apply_ops(st, batch)
+            if has_delta:
+                st, info = self.spec.apply_ops_delta(st, batch)
+                dropped = info["slots_dropped"]
+            else:
+                st = self.spec.apply_ops(st, batch)
+                dropped = jnp.int32(0)
             sel_mask = (
                 jnp.zeros((w * n,), bool).at[idx].set(chosen).reshape(w, n)
             )
-            return st, sel_mask
+            return st, sel_mask, dropped
 
-        return jax.vmap(one_view)(state, select, order_key)
+        st, sel_mask, dropped = jax.vmap(one_view)(state, select, order_key)
+        return st, sel_mask, jnp.sum(dropped)
 
     def _state_transfer(self, prospective, stable, dag_state, cstate,
                         prosp_applied, stable_applied, force):
@@ -367,7 +380,7 @@ class SafeKV:
         prosp_ready = self._causal_closure(dag_state, prosp_applied)
         rel_round = (dag_state["slot_round"] - dag_state["base_round"])
         round_key = rel_round[None, :, None] * n + jnp.arange(n)[None, None, :]
-        prospective, prosp_sel = self._delta_apply(
+        prospective, prosp_sel, drop_p = self._delta_apply(
             prospective, ops_buffer, prosp_ready & ~prosp_applied,
             jnp.broadcast_to(round_key, (n, w, n)),
         )
@@ -381,8 +394,13 @@ class SafeKV:
         seq_snap = cstate["commit_seq"]                # pre-GC, for host log
         pending = cstate["committed"] & ~stable_applied  # incl. budget spill
         ckey = tusk.order_key(cfg, cstate, base=dag_state["base_round"])
-        stable, stable_sel = self._delta_apply(stable, ops_buffer, pending, ckey)
+        stable, stable_sel, drop_s = self._delta_apply(
+            stable, ops_buffer, pending, ckey)
         stable_applied = stable_applied | stable_sel
+        # drop events are counted per state application (prospective and
+        # stable replay the same block independently, each under its own
+        # capacity pressure)
+        slots_dropped = drop_p + drop_s
 
         # -- GC: advance the frontier past rounds finished by the GC
         # quorum. The frontier is QUORUM-based, not unanimity-based (a
@@ -482,7 +500,7 @@ class SafeKV:
 
         return (prospective, stable, dag_state, cstate, ops_buffer,
                 buffer_filled, prosp_applied, stable_applied, fresh_com,
-                seq_snap, recycled, transferred, donor, lost)
+                seq_snap, recycled, transferred, donor, lost, slots_dropped)
 
     def _step_device(self, prospective, stable, dag_state, cstate, ops_buffer,
                      buffer_filled, prosp_applied, stable_applied, force,
@@ -505,7 +523,7 @@ class SafeKV:
             prosp_applied, ops, active)
         (prospective, stable, dag_state, cstate, ops_buffer, buffer_filled,
          prosp_applied, stable_applied, fresh_com, _seq_snap, recycled,
-         _transferred, _donor, lost) = self._tick_device(
+         _transferred, _donor, lost, slots_dropped) = self._tick_device(
             prospective, stable, dag_state, cstate, ops_buffer,
             buffer_filled, prosp_applied, stable_applied, force,
             active, withhold, invalid)
@@ -516,6 +534,7 @@ class SafeKV:
             accepted.astype(jnp.int32),             # [N]
             own.reshape(-1).astype(jnp.int32),      # [N*W]
             recycled.astype(jnp.int32),             # [W]
+            slots_dropped.astype(jnp.int32)[None],  # [1]
         ]
         if self.collect_logs:
             parts += [
@@ -567,6 +586,13 @@ class SafeKV:
                 self.ops_buffer, self.buffer_filled, self.prosp_applied,
                 self.stable_applied, self.force_transfer, ops_k,
                 active, withhold, invalid)
+        return packed_k, self._k_metas(k, safe_k, record)
+
+    def _k_metas(self, k: int, safe_k, record) -> list:
+        """Host-side metas for K dispatched rounds (shared by the
+        single-type step_k path and the MultiKV megatick): one
+        (stamp, tick, safe, record-mask) tuple per round, advancing the
+        tick counter."""
         n = self.cfg.num_nodes
         if record is True:
             rec_mask = np.ones((n,), bool)
@@ -580,7 +606,7 @@ class SafeKV:
             safe = None if safe_k is None else np.asarray(safe_k[j], bool)
             metas.append((now, self.tick_count, safe, rec_mask))
             self.tick_count += 1
-        return packed_k, metas
+        return metas
 
     def step_k_absorb(self, packed_k, metas,
                       observed_at: float | None = None) -> list:
@@ -669,15 +695,19 @@ class SafeKV:
 
     def _absorb_commits(self, own: np.ndarray, rec: np.ndarray,
                         tick_idx: int, now: float,
-                        update_rounds: bool) -> np.ndarray:
+                        update_rounds: bool, dropped: int = 0) -> np.ndarray:
         """Shared host bookkeeping for one completed tick — the split
         tick() and fused step_absorb() paths must stay byte-identical
         here (newly-committed detection, latency logs, safe acks,
         recycled-slot resets). ``own`` is the [W, N] own-block commit
-        mask; ``rec`` the [W] recycled mask."""
+        mask; ``rec`` the [W] recycled mask; ``dropped`` the tick's
+        capacity-pressure slot losses (device-counted)."""
         apply_t0 = time.perf_counter_ns()
         self.stats["ticks"] += 1
         self.stats["own_commits"] += int(own.sum())
+        if dropped:
+            self.stats["slots_dropped"] += dropped
+            get_registry().counter("slots_dropped_total").add(dropped)
         if rec.any():
             self.stats["slots_recycled"] += int(rec.sum())
             self.stats["gc_advances"] += 1
@@ -742,7 +772,7 @@ class SafeKV:
         (self.prospective, self.stable, self.dag, self.commit,
          self.ops_buffer, self.buffer_filled, self.prosp_applied,
          self.stable_applied, fresh_com, seq_snap, recycled, transferred,
-         donor, lost) = self._jit_tick(
+         donor, lost, slots_dropped) = self._jit_tick(
             self.prospective, self.stable, self.dag, self.commit,
             self.ops_buffer, self.buffer_filled, self.prosp_applied,
             self.stable_applied, self.force_transfer, active, withhold,
@@ -785,7 +815,8 @@ class SafeKV:
 
         self._absorb_commits(own, np.asarray(recycled),
                              self.tick_count - 1, time.perf_counter(),
-                             update_rounds=False)
+                             update_rounds=False,
+                             dropped=int(np.asarray(slots_dropped)))
         self._host_slot_round = np.asarray(self.dag["slot_round"]).astype(np.int64)
         return fresh_com
 
@@ -850,6 +881,7 @@ class SafeKV:
         own = flat[2 * n: 2 * n + n * w].reshape(n, w).T.astype(bool)  # [W,N]
         base = 2 * n + n * w
         rec = flat[base: base + w].astype(bool)
+        dropped = int(flat[base + w])
         now = observed_at if observed_at is not None else time.perf_counter()
 
         s = pre_round % w
@@ -872,7 +904,7 @@ class SafeKV:
             # mirror tick()'s total-order bookkeeping from the packed
             # extras: donor copy on transfer, then per-view ordered
             # append using the PRE-recycle slot->round map
-            off = base + w
+            off = base + w + 1  # + the slots_dropped scalar
             transferred = flat[off: off + n].astype(bool)
             donor = int(flat[off + n])
             off += n + 1
@@ -893,12 +925,14 @@ class SafeKV:
                     self.commit_log[v].extend(
                         (int(rounds[ss[i]]), int(src[i])) for i in order
                     )
-            self._absorb_commits(own, rec, tick_idx, now, update_rounds=False)
+            self._absorb_commits(own, rec, tick_idx, now, update_rounds=False,
+                                 dropped=dropped)
             self._host_slot_round = slot_round
         else:
-            self._absorb_commits(own, rec, tick_idx, now, update_rounds=True)
+            self._absorb_commits(own, rec, tick_idx, now, update_rounds=True,
+                                 dropped=dropped)
         return {"accepted": acc, "own": own, "recycled": rec, "slot": s,
-                "round": pre_round.copy()}
+                "round": pre_round.copy(), "slots_dropped": dropped}
 
     def step(self, ops: base.OpBatch, safe: Optional[np.ndarray] = None,
              active=None, withhold=None, record=True, invalid=None) -> dict:
@@ -1013,3 +1047,103 @@ class SafeKV:
                 [tuple(map(int, row)) for row in data[f"commit_log.{v}"]]
                 for v in range(self.cfg.num_nodes)
             ]
+
+
+class MultiKV:
+    """Fused multi-type megatick: K consensus rounds for EVERY registered
+    SafeKV lowered into ONE jitted program / one host->device dispatch.
+
+    A multi-type service dispatches one jitted step-k program per type
+    today, so a depth-K drive of a two-type key space costs 2 host->device
+    round trips per megatick (and 2K for unfused per-round stepping). Here
+    every kv's fused ``_step_device`` rides the SAME ``lax.scan``: the
+    scan body advances each type one protocol round, so the whole K-round
+    all-types megatick is ONE dispatch, with each type's packed host
+    outputs stacked [K, P_type] for one fetch apiece at absorb time.
+
+    All kvs must share the cluster geometry (N nodes, W window rounds) —
+    they emulate one cluster hosting several typed key spaces, like the
+    reference's SafeCRDTManager multiplexing types over one DAG. Types,
+    block widths, and key-space dims may differ freely.
+    """
+
+    def __init__(self, kvs: Dict[str, SafeKV]):
+        if not kvs:
+            raise ValueError("MultiKV needs at least one SafeKV")
+        geos = {(kv.cfg.num_nodes, kv.cfg.num_rounds) for kv in kvs.values()}
+        if len(geos) != 1:
+            raise ValueError(f"kvs disagree on cluster geometry: {geos}")
+        self.kvs = dict(kvs)
+        self._names = tuple(sorted(kvs))
+        self._jit = None
+        self.trace_count = 0      # +1 per (re)trace — the recompile guard
+        self.dispatch_count = 0   # +1 per megatick dispatch
+
+    def _carry(self, kv: SafeKV):
+        return (kv.prospective, kv.stable, kv.dag, kv.commit, kv.ops_buffer,
+                kv.buffer_filled, kv.prosp_applied, kv.stable_applied,
+                kv.force_transfer)
+
+    def _restore(self, kv: SafeKV, carry) -> None:
+        (kv.prospective, kv.stable, kv.dag, kv.commit, kv.ops_buffer,
+         kv.buffer_filled, kv.prosp_applied, kv.stable_applied,
+         kv.force_transfer) = carry
+
+    def _build(self):
+        names, kvs = self._names, self.kvs
+        multi = self
+
+        def fused(carries, ops_k):
+            multi.trace_count += 1  # python side effect: runs at TRACE time
+
+            def body(carry, ops):
+                nxt, packed = {}, {}
+                for name in names:
+                    out = kvs[name]._step_device(
+                        *carry[name], ops[name], None, None, None)
+                    nxt[name] = out[:9]
+                    packed[name] = out[9]
+                return nxt, packed
+
+            return jax.lax.scan(body, carries, ops_k)
+
+        return jax.jit(fused)
+
+    def step_k_dispatch(self, ops_k: Dict[str, base.OpBatch], safe_k=None,
+                        record=True):
+        """Dispatch K fused megaticks: ``ops_k[name]`` stacks K op batches
+        [K, N, B_name] per field for each kv. Returns ``(packed_k,
+        metas)`` dicts keyed like ``self.kvs``; pass both to
+        ``step_k_absorb`` in dispatch order. ``safe_k`` and ``record``
+        may be dicts keyed by kv name or one value for every kv."""
+        if self._jit is None:
+            self._jit = self._build()
+        k = int(next(iter(next(iter(ops_k.values())).values())).shape[0])
+        carries = {name: self._carry(self.kvs[name]) for name in self._names}
+        carries, packed_k = self._jit(carries, ops_k)
+        for name in self._names:
+            self._restore(self.kvs[name], carries[name])
+        self.dispatch_count += 1
+
+        def pick(v, name):
+            return v[name] if isinstance(v, dict) else v
+
+        metas = {
+            name: self.kvs[name]._k_metas(
+                k, pick(safe_k, name), pick(record, name))
+            for name in self._names
+        }
+        return packed_k, metas
+
+    def step_k_absorb(self, packed_k, metas, observed_at: float | None = None):
+        """Absorb every kv's K packed outputs (one fetch per kv)."""
+        return {
+            name: self.kvs[name].step_k_absorb(
+                packed_k[name], metas[name], observed_at=observed_at)
+            for name in self._names
+        }
+
+    def step_k(self, ops_k, safe_k=None, record=True):
+        """Synchronous megatick: dispatch + absorb in one call."""
+        packed_k, metas = self.step_k_dispatch(ops_k, safe_k, record)
+        return self.step_k_absorb(packed_k, metas)

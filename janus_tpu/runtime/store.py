@@ -19,12 +19,13 @@ fully converge all R replicas, in-place, with static shapes.
 from __future__ import annotations
 
 import math
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 
 from janus_tpu.models import base
+from janus_tpu.obs.metrics import get_registry
 
 
 def replicated_init(spec: base.CRDTTypeSpec, num_replicas: int, **dims) -> Any:
@@ -39,6 +40,13 @@ def replicated_init(spec: base.CRDTTypeSpec, num_replicas: int, **dims) -> Any:
 def apply_replica_ops(spec: base.CRDTTypeSpec, state: Any, ops: base.OpBatch) -> Any:
     """Apply per-replica op batches: each field of ``ops`` is [R, B]."""
     return jax.vmap(spec.apply_ops)(state, ops)
+
+
+def apply_replica_ops_delta(spec: base.CRDTTypeSpec, state: Any, ops: base.OpBatch):
+    """Delta-tracking apply: ``(state, dirty[R, K], slots_dropped)`` —
+    the per-replica dirty masks stacked, drops summed over replicas."""
+    st, info = jax.vmap(spec.apply_ops_delta)(state, ops)
+    return st, info["dirty"], jnp.sum(info["slots_dropped"])
 
 
 def gossip_step(spec: base.CRDTTypeSpec, state: Any, distance: int = 1) -> Any:
@@ -77,30 +85,101 @@ def converge(spec: base.CRDTTypeSpec, state: Any) -> Any:
     )
 
 
+def converge_delta(spec: base.CRDTTypeSpec, state: Any, dirty: jnp.ndarray,
+                   budget: int):
+    """Delta anti-entropy: converge only the union-dirty key rows.
+
+    ``dirty`` is bool[R, K] — the rows each replica has changed since the
+    last convergence. The post-converge invariant (all rows bit-equal
+    across replicas AND in canonical slot form, which ``converge`` / empty
+    init establish and ops-only mutation preserves) means clean rows need
+    no work: joining a bit-equal canonical row with itself is the identity.
+    So the union-dirty rows are gathered into a compact [R, D, ...] slab
+    (static dirty budget D), tree-reduced there, and scattered back —
+    bit-exactly what full ``converge`` produces, at O(D/K) of its join
+    cost. Dirty rows are placed first by a stable argsort, so the slab's
+    padding is clean rows, harmless by the same idempotence.
+
+    When the union-dirty count exceeds D the same program falls back to
+    the full converge via ``lax.cond`` — counted, never silently wrong.
+
+    Returns ``(state, overflowed bool, dirty_count int32)``.
+    """
+    num_replicas = jax.tree.leaves(state)[0].shape[0]
+    dirty_u = jnp.any(dirty, axis=0)                       # [K]
+    count = jnp.sum(dirty_u.astype(jnp.int32))
+    # stable sort keeps key order within each class; dirty rows first
+    idx = jnp.argsort(~dirty_u, stable=True)[:budget]
+
+    def _delta(st):
+        slab = jax.tree.map(lambda x: x[:, idx], st)       # [R, D, ...]
+        joined = join_all(spec, slab)                      # [D, ...]
+        # out-of-range idx only occurs on zero-size meta leaves (e.g.
+        # RGA's _depth shape carrier), where gather/scatter touch nothing
+        return jax.tree.map(
+            lambda x, j: x.at[:, idx].set(
+                jnp.broadcast_to(j, (num_replicas,) + j.shape)),
+            st, joined)
+
+    overflowed = count > budget
+    out = jax.lax.cond(overflowed, lambda st: converge(spec, st), _delta, state)
+    return out, overflowed, count
+
+
 class Store:
     """A host-side handle on R emulated replicas of several typed key
     spaces, with jitted apply/converge per type.
 
     The mutable-object-store role of the reference's ReplicationManager
     (CreateCRDTInstance / GetCRDT / inbound-merge) shrinks to: a dict of
-    state pytrees plus three pure jitted functions.
+    state pytrees plus a few pure jitted functions.
+
+    Delta convergence: with ``dirty_budget=D``, applies track per-replica
+    dirty key masks (via each spec's ``apply_ops_delta``) and ``sync_delta``
+    / delta ``fused_tick`` converge only the union-dirty slab (see
+    ``converge_delta``). ``fused_tick`` lowers apply + converge for EVERY
+    registered type into ONE jitted program — a depth-K drive of a
+    multi-type key space is one dispatch per tick instead of one per type
+    per phase.
     """
 
-    def __init__(self, num_replicas: int, types: Dict[str, Dict[str, int]]):
+    def __init__(self, num_replicas: int, types: Dict[str, Dict[str, int]],
+                 dirty_budget: Optional[int] = None):
         self.num_replicas = num_replicas
+        self.dirty_budget = dirty_budget
         self.specs = {tc: base.get_type(tc) for tc in types}
         self.states = {
             tc: replicated_init(self.specs[tc], num_replicas, **dims)
             for tc, dims in types.items()
         }
+        self.num_keys = {tc: int(dims["num_keys"]) for tc, dims in types.items()}
+        # per-replica dirty masks: rows changed since the last convergence
+        self.dirty = {
+            tc: jnp.zeros((num_replicas, self.num_keys[tc]), bool)
+            for tc in types
+        }
         self._apply = {
             tc: jax.jit(lambda s, o, _spec=self.specs[tc]: apply_replica_ops(_spec, s, o))
             for tc in types
+        }
+        self._apply_delta = {
+            tc: jax.jit(
+                lambda s, o, d, _spec=self.specs[tc]: _apply_and_track(_spec, s, o, d))
+            for tc in types if self.specs[tc].apply_ops_delta is not None
         }
         self._converge = {
             tc: jax.jit(lambda s, _spec=self.specs[tc]: converge(_spec, s))
             for tc in types
         }
+        if dirty_budget is not None:
+            self._converge_delta = {
+                tc: jax.jit(
+                    lambda s, d, _spec=self.specs[tc]:
+                        converge_delta(_spec, s, d, dirty_budget))
+                for tc in types
+            }
+        else:
+            self._converge_delta = {}
         self._step = {
             tc: jax.jit(
                 lambda s, d, _spec=self.specs[tc]: gossip_step(_spec, s, d),
@@ -108,16 +187,172 @@ class Store:
             )
             for tc in types
         }
+        # device-side drop accumulator, flushed to the registry at sync points
+        self._dropped = jnp.int32(0)
+        self._sync_all_jit = None
+        # fused megatick machinery (built lazily per (delta-mode, type-set))
+        self._fused = None
+        self._fused_key = None
+        self._fused_acc = None
+        self.fused_trace_count = 0      # +1 per (re)trace — recompile guard
+        self.fused_dispatch_count = 0   # +1 per fused_tick call
+        self._ticks_since_flush = 0
 
     def apply(self, type_code: str, ops: base.OpBatch) -> None:
-        self.states[type_code] = self._apply[type_code](self.states[type_code], ops)
+        if type_code in self._apply_delta:
+            st, dirty, dropped = self._apply_delta[type_code](
+                self.states[type_code], ops, self.dirty[type_code])
+            self.states[type_code] = st
+            self.dirty[type_code] = dirty
+            self._dropped = self._dropped + dropped
+        else:
+            self.states[type_code] = self._apply[type_code](
+                self.states[type_code], ops)
+            # no delta capability: conservatively all-dirty
+            self.dirty[type_code] = jnp.ones_like(self.dirty[type_code])
 
     def gossip(self, type_code: str, distance: int = 1) -> None:
+        # gossip merges bit-equal clean rows into themselves (idempotent
+        # canonical join) — it can only change already-dirty rows, so the
+        # dirty mask stays valid as-is
         self.states[type_code] = self._step[type_code](self.states[type_code], distance)
 
     def sync(self, type_code: str) -> None:
         """Converge all replicas (the full anti-entropy round)."""
         self.states[type_code] = self._converge[type_code](self.states[type_code])
+        self.dirty[type_code] = jnp.zeros_like(self.dirty[type_code])
+        self._flush_dropped()
+
+    def sync_delta(self, type_code: str) -> None:
+        """Converge via the union-dirty slab (full-converge fallback when
+        no budget is configured, the type lacks delta capability, or the
+        dirty count overflows the budget — the overflow is counted)."""
+        if type_code not in self._converge_delta:
+            return self.sync(type_code)
+        st, overflowed, count = self._converge_delta[type_code](
+            self.states[type_code], self.dirty[type_code])
+        self.states[type_code] = st
+        self.dirty[type_code] = jnp.zeros_like(self.dirty[type_code])
+        reg = get_registry()
+        reg.gauge(f"store_{type_code}_dirty_fraction").set(
+            float(count) / max(1, self.num_keys[type_code]))
+        if bool(overflowed):
+            reg.counter(f"store_{type_code}_delta_overflow_total").add(1)
+        self._flush_dropped()
+
+    def sync_all(self) -> None:
+        """Converge EVERY registered type in one jitted program (one
+        dispatch instead of one per type)."""
+        if self._sync_all_jit is None:
+            specs = self.specs
+
+            def _sync_all(states):
+                return {tc: converge(specs[tc], st) for tc, st in states.items()}
+
+            self._sync_all_jit = jax.jit(_sync_all)
+        self.states = dict(self._sync_all_jit(self.states))
+        for tc in self.dirty:
+            self.dirty[tc] = jnp.zeros_like(self.dirty[tc])
+        self._flush_dropped()
+
+    # -- fused multi-type megatick ----------------------------------------
+
+    def _build_fused(self, tcs, use_delta: bool):
+        specs = self.specs
+        budget = self.dirty_budget
+        store = self
+
+        def _fused(states, ops, dirty, acc):
+            store.fused_trace_count += 1  # runs at TRACE time only
+            new_states, new_dirty = {}, {}
+            acc = dict(acc)
+            for tc in tcs:
+                spec = specs[tc]
+                st, d = states[tc], dirty[tc]
+                if spec.apply_ops_delta is not None:
+                    st, dnew, dropped = apply_replica_ops_delta(spec, st, ops[tc])
+                    d = d | dnew
+                    acc["dropped"] = acc["dropped"] + dropped
+                else:
+                    st = apply_replica_ops(spec, st, ops[tc])
+                    d = jnp.ones_like(d)
+                if use_delta and spec.apply_ops_delta is not None:
+                    st, ovf, count = converge_delta(spec, st, d, budget)
+                    acc[f"overflow_{tc}"] = (
+                        acc[f"overflow_{tc}"] + ovf.astype(jnp.int32))
+                    acc[f"dirty_sum_{tc}"] = acc[f"dirty_sum_{tc}"] + count
+                else:
+                    st = converge(spec, st)
+                new_states[tc] = st
+                new_dirty[tc] = jnp.zeros_like(d)
+            return new_states, new_dirty, acc
+
+        return jax.jit(_fused)
+
+    def _fresh_acc(self, tcs, use_delta: bool):
+        acc = {"dropped": jnp.int32(0)}
+        if use_delta:
+            for tc in tcs:
+                if self.specs[tc].apply_ops_delta is not None:
+                    acc[f"overflow_{tc}"] = jnp.int32(0)
+                    acc[f"dirty_sum_{tc}"] = jnp.int32(0)
+        return acc
+
+    def fused_tick(self, ops_by_type: Dict[str, base.OpBatch],
+                   delta: Optional[bool] = None) -> None:
+        """One megatick: apply + converge every type in ``ops_by_type``
+        as ONE XLA program / ONE host->device dispatch. ``delta=None``
+        uses the delta path iff a ``dirty_budget`` is configured."""
+        use_delta = (self.dirty_budget is not None) if delta is None else bool(delta)
+        if use_delta and self.dirty_budget is None:
+            raise ValueError("delta fused_tick requires a dirty_budget")
+        tcs = tuple(sorted(ops_by_type))
+        key = (use_delta, tcs)
+        if self._fused_key != key:
+            self._fused = self._build_fused(tcs, use_delta)
+            self._fused_key = key
+            self._fused_acc = self._fresh_acc(tcs, use_delta)
+        states = {tc: self.states[tc] for tc in tcs}
+        dirty = {tc: self.dirty[tc] for tc in tcs}
+        new_states, new_dirty, self._fused_acc = self._fused(
+            states, ops_by_type, dirty, self._fused_acc)
+        self.states.update(new_states)
+        self.dirty.update(new_dirty)
+        self.fused_dispatch_count += 1
+        self._ticks_since_flush += 1
+
+    def flush_metrics(self) -> Dict[str, float]:
+        """Fetch the device-side per-tick accumulators into the metrics
+        registry (one blocking transfer, amortized over all fused ticks).
+        Returns {type_code: mean dirty fraction} for delta-converged
+        types."""
+        reg = get_registry()
+        out: Dict[str, float] = {}
+        self._flush_dropped()
+        if self._fused_acc is None:
+            return out
+        acc = {k: int(v) for k, v in self._fused_acc.items()}
+        use_delta, tcs = self._fused_key
+        ticks = max(1, self._ticks_since_flush)
+        if acc["dropped"]:
+            reg.counter("slots_dropped_total").add(acc["dropped"])
+        for tc in tcs:
+            if f"overflow_{tc}" in acc:
+                if acc[f"overflow_{tc}"]:
+                    reg.counter(f"store_{tc}_delta_overflow_total").add(
+                        acc[f"overflow_{tc}"])
+                frac = acc[f"dirty_sum_{tc}"] / ticks / max(1, self.num_keys[tc])
+                reg.gauge(f"store_{tc}_dirty_fraction").set(frac)
+                out[tc] = frac
+        self._fused_acc = self._fresh_acc(tcs, use_delta)
+        self._ticks_since_flush = 0
+        return out
+
+    def _flush_dropped(self) -> None:
+        n = int(self._dropped)
+        if n:
+            get_registry().counter("slots_dropped_total").add(n)
+        self._dropped = jnp.int32(0)
 
     def query(self, type_code: str, name: str, *args):
         """Run a type query on every replica (args broadcast)."""
@@ -127,3 +362,9 @@ class Store:
 
     def rounds_to_converge(self) -> int:
         return max(1, math.ceil(math.log2(max(2, self.num_replicas))))
+
+
+def _apply_and_track(spec: base.CRDTTypeSpec, state, ops, dirty):
+    """Apply + OR the new dirty rows into the running mask (one program)."""
+    st, dnew, dropped = apply_replica_ops_delta(spec, state, ops)
+    return st, dirty | dnew, dropped

@@ -64,6 +64,12 @@ def _smoke_cfg(name, cfg):
     elif name == "mixed":
         over = dict(num_nodes=4, num_objects=64, ops_per_block=32,
                     ticks=2)
+    elif name == "mixed_delta":
+        # >= 3 ticks so at least two land in the tick-time histograms
+        # (tick 0 carries the compile and is excluded); 4 nodes keeps
+        # the two fused two-type programs (full + delta) seconds-cheap
+        over = dict(num_nodes=4, num_objects=64, ops_per_block=4,
+                    ticks=3, dirty_budget=16)
     else:
         over = dict(num_nodes=4, num_objects=min(cfg.num_objects, 64),
                     ops_per_block=min(cfg.ops_per_block, 64),

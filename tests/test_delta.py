@@ -1,0 +1,228 @@
+"""Delta-state convergence: the union-dirty slab converge must be
+BIT-EXACT with the full tree-reduce converge — it is an optimization of
+the anti-entropy round, never a semantic change. Property-tested for
+ORSet and PNCounter over random op streams, including the counted
+``lax.cond`` fallback when the dirty count overflows the slab budget,
+plus the Store-level plumbing (sync_delta / sync_all / fused_tick and
+its recompile guard).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from janus_tpu.models import base, orset, pncounter
+from janus_tpu.runtime.store import (
+    Store, apply_replica_ops, apply_replica_ops_delta, converge,
+    converge_delta, replicated_init)
+from janus_tpu.utils.ids import TagMinter
+
+R, B, K = 4, 8, 32
+
+
+def _pnc_stream(rng, ticks, noop_frac=0.2):
+    out = []
+    writer = np.broadcast_to(np.arange(R, dtype=np.int32)[:, None], (R, B))
+    for _ in range(ticks):
+        op = rng.integers(pncounter.OP_INC, pncounter.OP_DEC + 1, (R, B))
+        op = np.where(rng.random((R, B)) < noop_frac, base.OP_NOOP, op)
+        out.append(base.make_op_batch(
+            op=op.astype(np.int32),
+            key=rng.integers(0, K, (R, B)).astype(np.int32),
+            a0=rng.integers(1, 10, (R, B)), writer=writer))
+    return out
+
+
+def _orset_stream(rng, ticks, minters, noop_frac=0.2):
+    out = []
+    for _ in range(ticks):
+        is_add = rng.random((R, B)) < 0.6
+        tags = np.zeros((R, B, 2), np.int32)
+        for v in range(R):
+            lanes = np.nonzero(is_add[v])[0]
+            if lanes.size:
+                tags[v, lanes] = minters[v].mint_many(lanes.size)
+        op = np.where(is_add, orset.OP_ADD, orset.OP_REMOVE)
+        op = np.where(rng.random((R, B)) < noop_frac, base.OP_NOOP, op)
+        out.append(base.make_op_batch(
+            op=op.astype(np.int32),
+            key=rng.integers(0, K, (R, B)).astype(np.int32),
+            a0=rng.integers(0, 16, (R, B)),
+            a1=tags[..., 0], a2=tags[..., 1]))
+    return out
+
+
+def _streams(seed, ticks=6):
+    rng = np.random.default_rng(seed)
+    minters = [TagMinter(v) for v in range(R)]
+    return {
+        "pnc": (pncounter.SPEC,
+                replicated_init(pncounter.SPEC, R, num_keys=K, num_writers=R),
+                _pnc_stream(rng, ticks)),
+        "orset": (orset.SPEC,
+                  replicated_init(orset.SPEC, R, num_keys=K, capacity=64,
+                                  rm_capacity=4),
+                  _orset_stream(rng, ticks, minters)),
+    }
+
+
+def _assert_trees_equal(a, b, msg):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                      err_msg=msg)
+
+
+# jitted per (type, budget) ONCE for the whole module — the production
+# paths are jitted too, and eager slot-union chains are minutes-slow
+_TICKS = {}
+
+
+def _full_tick(tc, spec):
+    if ("full", tc) not in _TICKS:
+        _TICKS[("full", tc)] = jax.jit(
+            lambda s, o: converge(spec, apply_replica_ops(spec, s, o)))
+    return _TICKS[("full", tc)]
+
+
+def _delta_tick(tc, spec, budget):
+    key = ("delta", tc, budget)
+    if key not in _TICKS:
+        def tick(s, o):
+            st, dirty, dropped = apply_replica_ops_delta(spec, s, o)
+            st, ovf, count = converge_delta(spec, st, dirty, budget)
+            return st, dirty, ovf, count
+        _TICKS[key] = jax.jit(tick)
+    return _TICKS[key]
+
+
+@pytest.mark.parametrize("tc", ["pnc", "orset"])
+@pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize("budget", [2, K])
+def test_delta_converge_bitexact(tc, seed, budget):
+    """Full apply+converge vs delta apply+slab-converge over the same
+    random op stream: bit-identical states every tick. budget=2 forces
+    the overflow fallback (B random keys per replica dirty >> 2);
+    budget=K can never overflow (count <= K)."""
+    spec, state0, stream = _streams(seed)[tc]
+    full = state0
+    delta = state0
+    overflows = 0
+    for ops in stream:
+        full = _full_tick(tc, spec)(full, ops)
+        delta, dirty, ovf, count = _delta_tick(tc, spec, budget)(delta, ops)
+        # the dirty mask is exactly the keys of enabled ops
+        want = np.zeros((R, K), bool)
+        opv = np.asarray(ops["op"])
+        keyv = np.asarray(ops["key"])
+        for r in range(R):
+            want[r, keyv[r][opv[r] != base.OP_NOOP]] = True
+        np.testing.assert_array_equal(np.asarray(dirty), want)
+        overflows += int(ovf)
+        assert int(count) == int(want.any(axis=0).sum())
+        _assert_trees_equal(full, delta, f"{tc} diverged (budget={budget})")
+    if budget == 2:
+        assert overflows == len(stream)  # every tick fell back, counted
+    else:
+        assert overflows == 0
+
+
+@pytest.mark.parametrize("tc", ["pnc", "orset"])
+def test_delta_apply_matches_plain_apply(tc):
+    """apply_ops_delta's state output is the plain apply_ops state."""
+    spec, state, stream = _streams(7)[tc]
+    ap = jax.jit(lambda s, o: apply_replica_ops(spec, s, o))
+    apd = jax.jit(lambda s, o: apply_replica_ops_delta(spec, s, o)[0])
+    for ops in stream:
+        plain = ap(state, ops)
+        tracked = apd(state, ops)
+        _assert_trees_equal(plain, tracked, f"{tc} apply_ops_delta != apply_ops")
+        state = _full_tick(tc, spec)(state, ops)
+
+
+def _types():
+    return {"pnc": dict(num_keys=K, num_writers=R),
+            "orset": dict(num_keys=K, capacity=64, rm_capacity=4)}
+
+
+def _apply_all(store, ops_by_type):
+    for tc, ops in ops_by_type.items():
+        store.apply(tc, ops)
+
+
+def test_store_sync_delta_matches_sync():
+    _, _, pnc_stream = _streams(11)["pnc"]
+    _, _, or_stream = _streams(11)["orset"]
+    a = Store(R, _types())
+    b = Store(R, _types(), dirty_budget=K // 2)
+    for pops, oops in zip(pnc_stream, or_stream):
+        batch = {"pnc": pops, "orset": oops}
+        _apply_all(a, batch)
+        _apply_all(b, batch)
+        a.sync("pnc"), a.sync("orset")
+        b.sync_delta("pnc"), b.sync_delta("orset")
+        for tc in ("pnc", "orset"):
+            _assert_trees_equal(a.states[tc], b.states[tc],
+                                f"sync_delta diverged on {tc}")
+            assert not bool(np.asarray(b.dirty[tc]).any())
+
+
+def test_store_sync_all_matches_per_type_sync():
+    _, _, pnc_stream = _streams(13)["pnc"]
+    _, _, or_stream = _streams(13)["orset"]
+    a = Store(R, _types())
+    b = Store(R, _types())
+    for pops, oops in zip(pnc_stream, or_stream):
+        batch = {"pnc": pops, "orset": oops}
+        _apply_all(a, batch)
+        _apply_all(b, batch)
+        a.sync("pnc"), a.sync("orset")
+        b.sync_all()
+        for tc in ("pnc", "orset"):
+            _assert_trees_equal(a.states[tc], b.states[tc],
+                                f"sync_all diverged on {tc}")
+
+
+@pytest.mark.parametrize("budget,expect_overflow", [(K, False), (2, True)])
+def test_store_fused_tick_bitexact_and_compiles_once(budget, expect_overflow):
+    """>= 3 fused two-type megaticks: bit-exact vs the unfused reference
+    path, ONE trace total (the recompile guard — a retrace per tick
+    would hand the megatick's dispatch win straight back to the
+    compiler), one dispatch per tick."""
+    ticks = 4
+    rng = np.random.default_rng(17)
+    minters = [TagMinter(v) for v in range(R)]
+    pnc_stream = _pnc_stream(rng, ticks)
+    or_stream = _orset_stream(rng, ticks, minters)
+    ref = Store(R, _types())
+    fused = Store(R, _types(), dirty_budget=budget)
+    for pops, oops in zip(pnc_stream, or_stream):
+        batch = {"pnc": pops, "orset": oops}
+        _apply_all(ref, batch)
+        ref.sync("pnc"), ref.sync("orset")
+        fused.fused_tick(batch)
+        for tc in ("pnc", "orset"):
+            _assert_trees_equal(ref.states[tc], fused.states[tc],
+                                f"fused_tick diverged on {tc}")
+    assert fused.fused_trace_count == 1
+    assert fused.fused_dispatch_count == ticks
+    overflowed = {tc: n for tc, n in (
+        (tc, int(fused._fused_acc[f"overflow_{tc}"]))
+        for tc in ("pnc", "orset"))}
+    if expect_overflow:
+        assert all(n == ticks for n in overflowed.values())
+    else:
+        assert all(n == 0 for n in overflowed.values())
+    fracs = fused.flush_metrics()
+    assert set(fracs) == {"pnc", "orset"}
+    assert all(0.0 < f <= 1.0 for f in fracs.values())
+
+
+def test_converge_delta_zero_dirty_is_noop():
+    """An all-clean mask leaves the state untouched (and cheap)."""
+    spec, state, stream = _streams(23)["orset"]
+    state = _full_tick("orset", spec)(state, stream[0])
+    out, ovf, count = jax.jit(
+        lambda s, d: converge_delta(spec, s, d, 4))(
+            state, jnp.zeros((R, K), bool))
+    assert not bool(ovf) and int(count) == 0
+    _assert_trees_equal(state, out, "clean converge_delta mutated state")

@@ -55,6 +55,26 @@ def test_saturation_grows_to_ceiling():
     assert changes == sorted(changes)
 
 
+def test_delta_overflow_is_shrink_pressure():
+    cfg = SchedulerConfig(b_min=64, b_max=5120, window=8,
+                          latency_target_ms=50.0, adjust_every=2)
+    sched = AdaptiveTick(cfg, b0=5120, registry=Registry())
+    # saturated AND fast — would normally hold/grow — but the delta
+    # path overflowed its budget on a majority of ticks: shrink
+    for _ in range(4):
+        sched.observe(10_000, 5.0)
+        sched.observe_delta(0.5, overflowed=True)
+        t = sched.maybe_adjust()
+    assert sched.b < 5120
+    # minority overflow changes nothing: saturation still grows
+    sched2 = AdaptiveTick(cfg, b0=1024, registry=Registry())
+    for i in range(4):
+        sched2.observe(10_000, 5.0)
+        sched2.observe_delta(0.1, overflowed=(i == 0))
+        sched2.maybe_adjust()
+    assert sched2.b > 1024
+
+
 def test_never_exceeds_ring_window_bound():
     # W x B must stay under max_inflight_ops: bound = 1024 // 8 = 128
     cfg = SchedulerConfig(b_min=32, b_max=5120, window=8,

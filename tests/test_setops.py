@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 from janus_tpu.ops import SENTINEL, make_slots, row_find, row_insert, row_upsert, slot_union
+from janus_tpu.ops.setops import mark_members
 
 
 def or_combine(p, q):
@@ -162,3 +163,103 @@ def test_row_find_insert_upsert():
     # disabled upsert is a no-op
     row2 = row_upsert(row, ("elem",), (jnp.int32(9),), {"ts": jnp.int32(1)}, comb, enabled=False)
     np.testing.assert_array_equal(np.asarray(row2["valid"]), np.asarray(row["valid"]))
+
+
+def test_row_insert_stats_counts_drops():
+    """A full row drops the insert AND counts it; disabled or successful
+    inserts count nothing."""
+    row = make_slots(2, {"elem": jnp.int32})
+    stats = {"slots_dropped": jnp.int32(0)}
+    row = row_insert(row, {"elem": jnp.int32(1)}, stats=stats)
+    row = row_insert(row, {"elem": jnp.int32(2)}, stats=stats)
+    assert int(stats["slots_dropped"]) == 0
+    row = row_insert(row, {"elem": jnp.int32(3)}, stats=stats)  # full: drop
+    assert int(stats["slots_dropped"]) == 1
+    row = row_insert(row, {"elem": jnp.int32(4)}, enabled=False, stats=stats)
+    assert int(stats["slots_dropped"]) == 1  # disabled lane never counts
+    assert sorted(np.asarray(row["elem"])[np.asarray(row["valid"])]) == [1, 2]
+
+
+def test_row_upsert_stats_counts_only_absent_key_drops():
+    """Folding into an existing key of a FULL row is not a drop; an
+    absent key hitting a full row is."""
+    comb = lambda old, new: {"ts": jnp.maximum(old["ts"], new["ts"])}
+    row = make_slots(2, {"elem": jnp.int32, "ts": jnp.int32})
+    stats = {"slots_dropped": jnp.int32(0)}
+    for e in (1, 2):
+        row = row_upsert(row, ("elem",), (jnp.int32(e),),
+                         {"ts": jnp.int32(e)}, comb, stats=stats)
+    row = row_upsert(row, ("elem",), (jnp.int32(1),), {"ts": jnp.int32(9)},
+                     comb, stats=stats)  # fold, row full: NOT a drop
+    assert int(stats["slots_dropped"]) == 0
+    row = row_upsert(row, ("elem",), (jnp.int32(7),), {"ts": jnp.int32(1)},
+                     comb, stats=stats)  # absent key, row full: drop
+    assert int(stats["slots_dropped"]) == 1
+    _, i1 = row_find(row, ("elem",), (jnp.int32(1),))
+    assert int(row["ts"][i1]) == 9
+
+
+# ---------------------------------------------------------------------------
+# mark_members edge cases (the membership primitive compaction fences use)
+# ---------------------------------------------------------------------------
+
+def _mark_ref(a_keys, b_keys, b_valid):
+    """O(M*T) reference model."""
+    k1a, k2a = (np.asarray(k) for k in a_keys)
+    k1b, k2b = (np.asarray(k) for k in b_keys)
+    bv = np.asarray(b_valid)
+    live = {(int(k1b[j]), int(k2b[j])) for j in np.nonzero(bv)[0]}
+    return np.array([(int(k1a[i]), int(k2a[i])) in live
+                     for i in range(k1a.shape[0])])
+
+
+def test_mark_members_empty_b_all_invalid():
+    """b_valid all False: nothing can match, even on exact key equality."""
+    a = (jnp.array([3, 5, 7], jnp.int32), jnp.array([1, 1, 1], jnp.int32))
+    b = (jnp.array([3, 5], jnp.int32), jnp.array([1, 1], jnp.int32))
+    got = mark_members(a, b, jnp.zeros(2, bool))
+    np.testing.assert_array_equal(np.asarray(got), np.zeros(3, bool))
+
+
+def test_mark_members_all_invalid_a_rows():
+    """A slots keyed SENTINEL (invalid) never match — not other SENTINEL
+    A rows, and not SENTINEL-masked invalid B entries."""
+    a = (jnp.full(4, SENTINEL, jnp.int32), jnp.full(4, SENTINEL, jnp.int32))
+    b = (jnp.array([SENTINEL, 2], jnp.int32), jnp.array([SENTINEL, 2], jnp.int32))
+    got = mark_members(a, b, jnp.array([False, True]))
+    np.testing.assert_array_equal(np.asarray(got), np.zeros(4, bool))
+
+
+def test_mark_members_degenerate_static_shapes():
+    """M=0 and T=0 short-circuit to all-False of static shape [M]."""
+    e = jnp.zeros(0, jnp.int32)
+    a = (jnp.array([1, 2], jnp.int32), jnp.array([3, 4], jnp.int32))
+    got_t0 = mark_members(a, (e, e), jnp.zeros(0, bool))
+    assert got_t0.shape == (2,) and not bool(got_t0.any())
+    got_m0 = mark_members((e, e), a, jnp.ones(2, bool))
+    assert got_m0.shape == (0,)
+
+
+def test_mark_members_keys_at_sentinel_minus_one():
+    """SENTINEL-1 is the largest legal key value: it must match like any
+    other key and never collide with the SENTINEL invalid marker."""
+    big = SENTINEL - 1
+    a = (jnp.array([big, big, 5], jnp.int32),
+         jnp.array([big, 0, big], jnp.int32))
+    b = (jnp.array([big, SENTINEL], jnp.int32),
+         jnp.array([big, SENTINEL], jnp.int32))
+    got = mark_members(a, b, jnp.array([True, True]))
+    np.testing.assert_array_equal(np.asarray(got), [True, False, False])
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_mark_members_matches_reference_model(seed):
+    rng = np.random.default_rng(40 + seed)
+    m, t = int(rng.integers(1, 20)), int(rng.integers(1, 20))
+    a = (jnp.asarray(rng.integers(0, 6, m), jnp.int32),
+         jnp.asarray(rng.integers(0, 6, m), jnp.int32))
+    b = (jnp.asarray(rng.integers(0, 6, t), jnp.int32),
+         jnp.asarray(rng.integers(0, 6, t), jnp.int32))
+    bv = jnp.asarray(rng.random(t) < 0.7)
+    got = mark_members(a, b, bv)
+    np.testing.assert_array_equal(np.asarray(got), _mark_ref(a, b, bv))
