@@ -193,6 +193,7 @@ def run_tensor(cfg: BenchConfig) -> Results:
 
     from janus_tpu.consensus import DagConfig
     from janus_tpu.models import base, orset, pncounter
+    from janus_tpu.obs import stages as obs_stages
     from janus_tpu.runtime.safecrdt import SafeKV
     from janus_tpu.utils.ids import TagMinter
 
@@ -385,9 +386,24 @@ def run_tensor(cfg: BenchConfig) -> Results:
             "per-read device latency of a precompiled single-key query; "
             "one backend fetch (floor reported separately) amortized "
             "over 8 reads")
+        # measured per-stage decomposition (telemetry plane), per type —
+        # mean/p50/p90/p99 per pipeline stage for this run's rows
+        res.extra[f"stages_{code}"] = obs_stages.summarize_stages(
+            kv.stage_scope)
     if planes:
         res.extra["pruned_blocks"] = sum(
             len(p.pruned_blocks()) for p in planes.values())
+        # fold per-node pruned-block counts through the watchdog's
+        # equivocation detector: a byzantine run flags the injecting
+        # nodes; the invalid_rate=0 control stays OK
+        from janus_tpu.obs import HealthWatchdog
+        merged: Dict[int, int] = {}
+        for p in planes.values():
+            for src, cnt in p.equivocation_counts().items():
+                merged[src] = merged.get(src, 0) + cnt
+        wd = HealthWatchdog()
+        wd.observe_equivocation(merged)
+        res.extra["health"] = wd.health()
     all_lags = np.concatenate([np.asarray(kv.latency_log)
                                for _, kv, _ in specs])
     res.extra["commit_lag_ticks_p50"] = int(np.percentile(all_lags, 50))
@@ -442,6 +458,7 @@ def run_tensor_adaptive(cfg: BenchConfig) -> Results:
     from janus_tpu.consensus import DagConfig
     from janus_tpu.models import base, orset, pncounter
     from janus_tpu.obs import AdaptiveTick, SchedulerConfig
+    from janus_tpu.obs import flight as obs_flight
     from janus_tpu.obs import stages as obs_stages
     from janus_tpu.runtime.safecrdt import SafeKV
     from janus_tpu.utils.ids import TagMinter
@@ -495,6 +512,8 @@ def run_tensor_adaptive(cfg: BenchConfig) -> Results:
 
     def one_tick(record: bool = True) -> int:
         B = kv.B
+        fl = obs_flight.get_recorder()
+        t_in = time.time_ns() if fl.enabled else 0
         offered = cfg.offered_per_tick
         batch = {c: np.zeros((n, B), np.int32) for c in cols}
         batch["writer"] = np.broadcast_to(
@@ -517,10 +536,24 @@ def run_tensor_adaptive(cfg: BenchConfig) -> Results:
             for c in cols:
                 batch[c][v, :take] = q[c][:take]
             boarded[v] = take
+        trace = None
+        if fl.enabled and record:
+            # one causal trace id per boarded block, named by the
+            # (node, tick) it boarded at; the boarding loop above IS
+            # this drive mode's ingest stage, so its span bounds are
+            # the tick entry and the dispatch handoff
+            trace = [None] * n
+            t1w = time.time_ns()
+            for v in range(n):
+                if boarded[v] > 0:
+                    tid = f"n{v}.t{kv.tick_count}"
+                    trace[v] = tid
+                    fl.span_at(tid, "ingest", t_in, t1w)
         t0 = time.perf_counter()
         info = kv.step(base.make_op_batch(**batch),
                        record=(np.asarray(boarded > 0) if record
-                               else False))
+                               else False),
+                       trace=trace)
         seal_s = time.perf_counter() - t0
         acc = info["accepted"]
         done = 0
@@ -648,6 +681,8 @@ def run_store_delta(cfg: BenchConfig) -> Results:
 
     batches = [jax.device_put(gen_tick(t)) for t in range(cfg.ticks)]
     reg = get_registry()
+    from janus_tpu.obs import HealthWatchdog
+    wd = HealthWatchdog()
 
     def drive(store: Store, use_delta: bool, hist_name: str):
         h = reg.histogram(hist_name)
@@ -660,6 +695,15 @@ def run_store_delta(cfg: BenchConfig) -> Results:
             if t > 0:  # tick 0 carries the jit compile
                 h.record_seconds(dt)
                 times.append(dt)
+            # liveness evidence: a shape-churning run shows the fused
+            # trace count rising tick over tick (recompile storm), and
+            # a hot window wider than the budget shows an unbroken
+            # overflow streak — both fold into extra["health"] below
+            wd.observe_trace_count(hist_name, store.fused_trace_count)
+            if use_delta:
+                for tc in types:
+                    wd.observe_overflow(tc, reg.counter(
+                        f"store_{tc}_delta_overflow_total").value)
         return np.asarray(times)
 
     full = Store(n, types)
@@ -701,6 +745,7 @@ def run_store_delta(cfg: BenchConfig) -> Results:
     res.extra["fused_trace_counts"] = {"full": full.fused_trace_count,
                                        "delta": delta.fused_trace_count}
     res.extra["states_bitequal"] = True
+    res.extra["health"] = wd.health()  # OK on a clean, shape-stable run
     return res
 
 
@@ -1175,6 +1220,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--preset", choices=sorted(PRESETS), help="named preset")
     ap.add_argument("--mode", choices=("tensor", "wire", "wire_native"))
     ap.add_argument("--json", action="store_true", help="emit JSON only")
+    ap.add_argument("--trace-out", metavar="PATH",
+                    help="enable the flight recorder for the run and "
+                         "write its causal spans as Chrome/Perfetto "
+                         "trace-event JSON (load at ui.perfetto.dev)")
+    ap.add_argument("--device-trace-dir", metavar="DIR",
+                    help="capture a jax.profiler device trace of the "
+                         "run; correlate with --trace-out by wall clock "
+                         "(flight spans carry absolute time.time_ns)")
     args = ap.parse_args(argv)
     if args.config:
         cfg = BenchConfig.from_json(open(args.config).read())
@@ -1182,7 +1235,19 @@ def main(argv: Optional[List[str]] = None) -> None:
         cfg = PRESETS[args.preset or "pnc"]
     if args.mode:
         cfg = dataclasses.replace(cfg, mode=args.mode)
-    res = run(cfg)
+    if args.trace_out:
+        from janus_tpu.obs import flight as obs_flight
+        obs_flight.enable()
+    from janus_tpu.utils.trace import device_trace
+    with device_trace(args.device_trace_dir):
+        res = run(cfg)
+    if args.trace_out:
+        import sys
+
+        from janus_tpu.obs import flight as obs_flight
+        from janus_tpu.obs.traceview import write_chrome_trace
+        n_ev = write_chrome_trace(args.trace_out, obs_flight.get_recorder())
+        print(f"# {n_ev} trace events -> {args.trace_out}", file=sys.stderr)
     if args.json:
         print(json.dumps(res.to_dict()))
     else:

@@ -211,6 +211,17 @@ class IntegrityPlane:
         PruneInvalidBlocks return (DAG.cs:258-297)."""
         return list(self.pruned)
 
+    def equivocation_counts(self) -> Dict[int, int]:
+        """Pruned-block count per source node — the health watchdog's
+        per-node equivocation signal. A node whose signatures keep
+        failing verification is either equivocating (signing content it
+        didn't send) or compromised; either way liveness degrades as its
+        blocks die unacked in their slots."""
+        counts: Dict[int, int] = {}
+        for _r, s in self.pruned:
+            counts[s] = counts.get(s, 0) + 1
+        return counts
+
 
 class SecureCluster:
     """SafeKV + IntegrityPlane glue: drives the emulated cluster with

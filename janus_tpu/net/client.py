@@ -272,6 +272,21 @@ class JanusClient:
         return json.loads(str(
             self.request("stats", "_", "g", timeout=timeout)["result"]))
 
+    def health(self, timeout: Optional[float] = None) -> Dict[str, object]:
+        """Watchdog verdict from the `health` command:
+        {"status": OK|DEGRADED|STALLED, "reasons": [...], ...}."""
+        import json
+        return json.loads(str(
+            self.request("health", "_", "g", timeout=timeout)["result"]))
+
+    def fetch_trace(self, timeout: Optional[float] = None) -> Dict[str, object]:
+        """The service flight recorder's contents as a Chrome trace-event
+        document (load at ui.perfetto.dev); empty unless the server
+        process enabled its recorder (obs.flight.enable)."""
+        import json
+        return json.loads(str(
+            self.request("trace", "_", "g", timeout=timeout)["result"]))
+
     def close(self):
         self._closed = True
         try:

@@ -38,9 +38,12 @@ from janus_tpu.consensus import tusk
 from janus_tpu.models import base
 from janus_tpu.net.binding import INTERN_BIT, NativeServer
 from janus_tpu.obs import AdaptiveTick, SchedulerConfig
+from janus_tpu.obs import flight as obs_flight
 from janus_tpu.obs import metrics as obs_metrics
 from janus_tpu.obs import stages as obs_stages
 from janus_tpu.obs.export import render_prometheus
+from janus_tpu.obs.traceview import chrome_trace_json
+from janus_tpu.obs.watchdog import HealthWatchdog, WatchdogConfig
 from janus_tpu.ops.lattice import SENTINEL
 from janus_tpu.runtime.keyspace import ReplicatedKeySpace
 from janus_tpu.runtime.safecrdt import SafeKV
@@ -96,6 +99,12 @@ class JanusConfig:
     bind_addr: str = "127.0.0.1"
     port: int = 0  # 0 -> ephemeral
     max_clients: int = 64
+    # health watchdog: consecutive no-commit steps (with ops pending)
+    # before the service reports STALLED
+    watchdog_stall_ticks: int = 200
+    # where anomaly-triggered flight-recorder dumps land ("" -> never
+    # write files; the recorder itself is enabled via obs.flight.enable)
+    flight_dump_dir: str = ""
     log_level: str = "info"  # debug|info|warning|error|off (Globals.cs
     # verbosity analog, threaded to every component logger)
     types: Tuple[TypeConfig, ...] = (
@@ -137,6 +146,8 @@ class JanusConfig:
             bind_addr=raw.get("bind_addr", "127.0.0.1"),
             port=int(raw.get("port", 0)),
             max_clients=int(raw.get("max_clients", 64)),
+            watchdog_stall_ticks=int(raw.get("watchdog_stall_ticks", 200)),
+            flight_dump_dir=raw.get("flight_dump_dir", ""),
             log_level=raw.get("log_level", "info"),
             types=types,
             procs=procs,
@@ -307,7 +318,16 @@ class JanusService:
         # Prometheus-text scrape endpoint, same in-band transport as
         # stats (any op on the type answers with the exposition)
         self._metrics_tid = self.server.register_type("metrics", 1)
+        # health snapshot + flight-recorder fetch, same in-band shape
+        self._health_tid = self.server.register_type("health", 1)
+        self._trace_tid = self.server.register_type("trace", 1)
         self._h_ingest = obs_stages.stage_histograms("svc")["ingest"]
+        # liveness watchdog fed once per step per type; dumps the flight
+        # recorder on first anomaly when a dump dir is configured
+        self.watchdog = HealthWatchdog(WatchdogConfig(
+            stall_ticks=cfg.watchdog_stall_ticks,
+            dump_dir=cfg.flight_dump_dir or None))
+        self._flight = obs_flight.get_recorder()
         # stable cross-process element ids (split mode): interned param
         # id -> hashed element id
         self._elem_cache: Dict[int, int] = {}
@@ -543,6 +563,26 @@ class JanusService:
         # flush staged queue entries in arrival order (columnar chunks
         # and per-item entries interleave exactly as their ops arrived)
         if self._stage:
+            fl = self._flight
+            if fl.enabled:
+                # causal ingest spans for safe updates: wire poll ->
+                # staged (trace id = client tag; the same id is elected
+                # as the block's trace when the op boards, closing the
+                # ingest -> seal -> ... chain). Safe ops only: unsafe
+                # updates are acked at ingest, their causal story ends
+                # here.
+                ingest_ns = time.perf_counter_ns() - t_ingest
+                t1w = time.time_ns()
+                t0w = t1w - max(0, ingest_ns)
+                for lst in self._stage.values():
+                    for _pos, e in lst:
+                        if e[0] == "chunk":
+                            for tg in e[1]["tag"][e[1]["safe"]].tolist():
+                                fl.span_at(f"c{int(tg)}", "ingest",
+                                           t0w, t1w)
+                        elif e[3]:  # ("item", fields, tag, safe, ckey)
+                            fl.span_at(f"c{int(e[2])}", "ingest",
+                                       t0w, t1w)
             for (tid, v), lst in self._stage.items():
                 lst.sort(key=lambda e: e[0])
                 q = self.types[tid].pending[v]
@@ -560,6 +600,12 @@ class JanusService:
             busy |= self._step_type(rt)
             self._materialize_creates(rt)
             self._send_safe_acks(rt)
+            # liveness evidence: ops pending with no own-view commit
+            # progress for stall_ticks steps flips health to STALLED
+            self.watchdog.observe_commits(
+                rt.spec.type_code, rt.kv.stats["own_commits"],
+                sum(len(e[1]["tag"]) if e[0] == "chunk" else 1
+                    for q in rt.pending for e in q))
         self.ticks += 1
 
         # answer reads post-tick, once (a) the key's create has committed
@@ -597,6 +643,15 @@ class JanusService:
             return
         if it["tid"] == self._metrics_tid:
             self._reply(tag, self._metrics_report(), "ok")
+            return
+        if it["tid"] == self._health_tid:
+            self._reply(tag, json.dumps(self.watchdog.health()), "ok")
+            return
+        if it["tid"] == self._trace_tid:
+            # flight-recorder fetch: Perfetto-loadable Chrome trace JSON
+            # of the ring's current contents (ui.perfetto.dev opens it)
+            self._reply(tag,
+                        chrome_trace_json(self._flight.snapshot()), "ok")
             return
         rt = self.types.get(it["tid"])
         if rt is None:
@@ -981,6 +1036,31 @@ class JanusService:
                              for v in range(n)])
         ops = base.make_op_batch(**batch)
 
+        # elect one representative trace id per boarding block (safe ops
+        # first — they are the traced end-to-end path; every op in the
+        # block shares its consensus fate anyway)
+        trace = None
+        if self._flight.enabled:
+            trace = [None] * n
+            for v in range(n):
+                tid_v = None
+                for _b, is_safe, tg, _ck in placed[v]:
+                    if tid_v is None or is_safe:
+                        tid_v = tg
+                        if is_safe:
+                            break
+                if tid_v is None or not any(
+                        s for _b, s, _t, _c in placed[v]):
+                    for _b0, head in fast_placed[v]:
+                        si = np.nonzero(head["safe"])[0]
+                        if si.size:
+                            tid_v = int(head["tag"][si[0]])
+                            break
+                        if tid_v is None:
+                            tid_v = int(head["tag"][0])
+                if tid_v is not None:
+                    trace[v] = f"c{int(tid_v)}"
+
         def requeue(v):
             for entry in reversed(taken[v]):
                 rt.pending[v].appendleft(entry)
@@ -993,7 +1073,7 @@ class JanusService:
                     requeue(v)
                 return had_ops
         else:
-            info = rt.kv.step(ops, safe=safe, record=record)
+            info = rt.kv.step(ops, safe=safe, record=record, trace=trace)
         self._sched_update(rt, time.perf_counter() - t_seal)
         accepted, slots = info["accepted"], info["slot"]
         for v in range(n):
@@ -1142,6 +1222,9 @@ class JanusService:
                 }
                 for rt in self.types.values()
             },
+            # watchdog verdict (OK / DEGRADED / STALLED + reasons; the
+            # standalone `health` command answers with just this)
+            "health": self.watchdog.health(),
             # full telemetry-plane snapshot (JSON exposition; the
             # Prometheus text form lives on the `metrics` command)
             "metrics": obs_metrics.get_registry().snapshot(),
@@ -1163,6 +1246,7 @@ class JanusService:
                 for q in rt.pending for e in q))
         reg.gauge("svc_ticks").set(self.ticks)
         reg.gauge("svc_ops_received").set(self.server.ops_received())
+        self.watchdog.health()  # refresh the watchdog_health gauge
         return render_prometheus(reg)
 
 

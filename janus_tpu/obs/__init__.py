@@ -10,7 +10,17 @@ registry, recorded from receive threads and the tick loop without
 device syncs or locks — and closes the loop: the AIMD block-size
 controller (obs/scheduler.py) reads the measured seal-latency histogram
 and resizes consensus blocks at runtime.
+
+PR 3 adds the causal layer on top of the aggregates: a bounded flight
+recorder of per-trace-id span events (obs/flight.py), a Perfetto
+exporter (obs/traceview.py), and a health watchdog deriving liveness
+verdicts — commit stall, recompile storm, overflow streaks,
+equivocation — from the same observations (obs/watchdog.py).
 """
+from janus_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    get_recorder,
+)
 from janus_tpu.obs.metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -20,3 +30,5 @@ from janus_tpu.obs.metrics import (  # noqa: F401
 )
 from janus_tpu.obs.scheduler import AdaptiveTick, SchedulerConfig  # noqa: F401
 from janus_tpu.obs.stages import STAGES, stage_histograms, time_stage  # noqa: F401
+from janus_tpu.obs.traceview import write_chrome_trace  # noqa: F401
+from janus_tpu.obs.watchdog import HealthWatchdog, WatchdogConfig  # noqa: F401

@@ -3,8 +3,13 @@
 Histograms render as the standard cumulative-bucket triple
 (``_bucket{le=...}``/``_sum``/``_count``) with power-of-two ``le`` edges
 — scrape-side tooling can recover the same percentiles the in-process
-snapshot reports. ``parse_prometheus`` is the inverse used by the
-client scrape helper and the round-trip tests.
+snapshot reports. Every edge from ``le="1"`` up to the highest observed
+bucket is emitted, zero-count edges included: Prometheus clients
+interpolate ``histogram_quantile`` linearly between ADJACENT emitted
+edges, so skipping an empty edge silently widens a bucket from one
+octave to many and wrecks the quantile estimate. ``parse_prometheus``
+is the inverse used by the client scrape helper and the round-trip
+tests.
 """
 from __future__ import annotations
 
@@ -40,20 +45,28 @@ def render_prometheus(registry=None) -> str:
         pname = _sanitize(name)
         kind = snap["type"]
         if kind == "counter":
+            lines.append(f"# HELP {pname} Monotonic counter {name}")
             lines.append(f"# TYPE {pname} counter")
             lines.append(f"{pname} {snap['value']}")
         elif kind == "gauge":
+            lines.append(f"# HELP {pname} Gauge {name}")
             lines.append(f"# TYPE {pname} gauge")
             lines.append(f"{pname} {_fmt(snap['value'])}")
         else:
+            unit = snap.get("unit", "ns")
+            lines.append(f"# HELP {pname} Histogram {name} "
+                         f"(power-of-two buckets, unit {unit})")
             lines.append(f"# TYPE {pname} histogram")
-            cum = 0
             buckets = snap["buckets"]
-            for i, hi in enumerate(BUCKET_HI):
-                c = buckets.get(str(hi), 0)
-                if not c:
-                    continue
-                cum += c
+            # every edge through the max observed bucket, zero-count
+            # edges included — clients interpolate between adjacent
+            # emitted edges, so a skipped empty edge merges octaves
+            max_i = max((i for i, hi in enumerate(BUCKET_HI)
+                         if buckets.get(str(hi), 0)), default=-1)
+            cum = 0
+            for i in range(max_i + 1):
+                hi = BUCKET_HI[i]
+                cum += buckets.get(str(hi), 0)
                 lines.append(f'{pname}_bucket{{le="{hi}"}} {cum}')
             lines.append(f'{pname}_bucket{{le="+Inf"}} {snap["count"]}')
             lines.append(f"{pname}_sum {snap['sum']}")

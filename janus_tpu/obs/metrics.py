@@ -21,7 +21,11 @@ Design constraints, in order:
 """
 from __future__ import annotations
 
+import re
 import threading
+
+# characters legal in a metric name; substitute the rest with "_"
+_NAME_SAFE = re.compile(r"[^a-zA-Z0-9_:]")
 
 NUM_BUCKETS = 64
 _MAX_IDX = NUM_BUCKETS - 1
@@ -55,6 +59,9 @@ class Counter:
     def value(self) -> int:
         return self._value
 
+    def reset(self) -> None:
+        self._value = 0
+
     def snapshot(self) -> dict:
         return {"type": "counter", "value": self._value}
 
@@ -74,9 +81,17 @@ class Gauge:
     def add(self, n: float = 1.0) -> None:
         self._value += n
 
+    def max(self, v: float) -> None:
+        """Ratchet upward: keep the largest value ever set."""
+        if v > self._value:
+            self._value = float(v)
+
     @property
     def value(self) -> float:
         return self._value
+
+    def reset(self) -> None:
+        self._value = 0.0
 
     def snapshot(self) -> dict:
         return {"type": "gauge", "value": self._value}
@@ -121,6 +136,11 @@ class Histogram:
 
     def counts(self) -> list:
         return list(self._counts)
+
+    def reset(self) -> None:
+        self._counts = [0] * NUM_BUCKETS
+        self._sum = 0
+        self._count = 0
 
     def percentile(self, q: float) -> float:
         """Interpolated q-quantile (q in [0,1]) from bucket ranks.

@@ -50,7 +50,9 @@ def time_stage(hist: Histogram):
 
 
 def summarize_stages(scope: str, registry=None) -> dict:
-    """Scrape-time p50/p99 (ms) per stage, for results/PERF reporting."""
+    """Scrape-time p50/p90/p99/mean (ms) per stage, for results/PERF
+    reporting — the harness threads this into results_r*.jsonl rows so
+    PERF.md tails are reproducible from raw data."""
     reg = registry if registry is not None else get_registry()
     out = {}
     for s in STAGES:
@@ -59,7 +61,9 @@ def summarize_stages(scope: str, registry=None) -> dict:
             continue
         out[s] = {
             "count": h.count,
+            "mean_ms": (h.sum / h.count) / 1e6,
             "p50_ms": h.percentile(0.50) / 1e6,
+            "p90_ms": h.percentile(0.90) / 1e6,
             "p99_ms": h.percentile(0.99) / 1e6,
         }
     return out

@@ -1,0 +1,76 @@
+"""Chrome trace-event JSON export for flight-recorder snapshots.
+
+Produces the legacy Chrome ``traceEvents`` JSON that ui.perfetto.dev
+(and chrome://tracing) load directly. Mapping:
+
+- each distinct ``trace_id`` becomes its own pseudo-thread (``tid``),
+  named by an ``"M"`` thread_name metadata event, so one safe update's
+  ingest -> seal -> dag_round -> commit -> apply chain reads as one
+  horizontal lane;
+- recorder ``"S"`` events (completed spans, detail = duration ns)
+  become ``"X"`` complete events with microsecond ``ts``/``dur`` —
+  complete events need no begin/end pairing, which the pipelined
+  dispatch/absorb split could not guarantee anyway;
+- recorder ``"I"`` events become instant events (scope ``"t"``) with
+  the detail preserved under ``args``.
+
+Timestamps are wall-clock ``time.time_ns`` so a ``jax.profiler`` device
+capture taken over the same interval (harness ``--device-trace-dir``)
+can be correlated by absolute time.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List
+
+from janus_tpu.obs.flight import Event, FlightRecorder
+
+PID = 1  # single emulated-cluster process; lanes are trace ids
+
+
+def chrome_trace_events(events: Iterable[Event]) -> List[dict]:
+    """Recorder events -> Chrome trace-event dicts (ts/dur in us)."""
+    tids: Dict[str, int] = {}
+    out: List[dict] = []
+    for t_ns, trace_id, span, kind, detail in events:
+        tid = tids.get(trace_id)
+        if tid is None:
+            tid = tids[trace_id] = len(tids) + 1
+            out.append({"ph": "M", "name": "thread_name", "pid": PID,
+                        "tid": tid, "args": {"name": trace_id}})
+        ts = t_ns / 1e3
+        if kind == "S":
+            out.append({"ph": "X", "name": span, "cat": "janus",
+                        "pid": PID, "tid": tid, "ts": ts,
+                        "dur": max(0.001, int(detail or 0) / 1e3)})
+        else:
+            out.append({"ph": "i", "name": span, "cat": "janus",
+                        "pid": PID, "tid": tid, "ts": ts, "s": "t",
+                        "args": {"detail": detail}})
+    return out
+
+
+def chrome_trace_json(events: Iterable[Event]) -> str:
+    return json.dumps({"traceEvents": chrome_trace_events(events),
+                       "displayTimeUnit": "ms"})
+
+
+def write_chrome_trace(path: str, recorder: FlightRecorder) -> int:
+    """Dump a recorder snapshot as Perfetto-loadable JSON; returns the
+    number of trace events written (metadata rows included)."""
+    events = chrome_trace_events(recorder.snapshot())
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)
+
+
+def span_chains(events: Iterable[Event]) -> Dict[str, List[str]]:
+    """trace_id -> ordered span names (``"S"`` events only), a helper
+    for tests asserting the full pipeline chain exists under one id."""
+    chains: Dict[str, List[dict]] = {}
+    for t_ns, trace_id, span, kind, _detail in events:
+        if kind != "S":
+            continue
+        chains.setdefault(trace_id, []).append({"t": t_ns, "s": span})
+    return {tid: [e["s"] for e in sorted(rows, key=lambda e: e["t"])]
+            for tid, rows in chains.items()}

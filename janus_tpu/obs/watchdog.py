@@ -1,0 +1,181 @@
+"""Consensus health watchdog: liveness signals from recorder + registry.
+
+Aggregate histograms say how fast the pipeline runs; the watchdog says
+whether it is running *at all*, and captures evidence when it stops.
+Four anomaly detectors, each fed by an ``observe_*`` call from the
+owner's tick loop (service pump, harness driver):
+
+- **commit stall** — ops are pending but the own-commit counter has not
+  advanced for ``stall_ticks`` consecutive observations. The Tusk ring
+  guarantees liveness while the cluster steps, so a stall means the
+  pipeline itself wedged (or, in tests, was deliberately suppressed).
+- **recompile storm** — the fused megatick's ``trace_count`` rose on
+  ``recompile_limit``-or-more of the last ``recompile_window``
+  observations: shapes are churning and every tick pays an XLA trace.
+- **overflow streak** — the delta-converge slab budget overflowed on
+  ``overflow_streak`` consecutive ticks, so the "delta" path is
+  silently running full converges.
+- **equivocation** — integrity verification pruned more than
+  ``equivocation_limit`` blocks from one source node.
+
+Each detector is edge-triggered: on the tick an anomaly first becomes
+active the watchdog dumps the process flight recorder to
+``dump_dir/flight_<anomaly>_<n>.jsonl`` (exactly once per activation —
+re-arming requires the condition to clear) and bumps
+``watchdog_anomalies_total``. ``health()`` folds the active set to
+OK / DEGRADED / STALLED with human-readable reasons and mirrors the
+status into the ``watchdog_health`` gauge (0/1/2).
+"""
+from __future__ import annotations
+
+import os
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from janus_tpu.obs import flight
+from janus_tpu.obs.metrics import get_registry
+
+OK, DEGRADED, STALLED = "OK", "DEGRADED", "STALLED"
+_LEVEL = {OK: 0, DEGRADED: 1, STALLED: 2}
+
+
+@dataclass(frozen=True)
+class WatchdogConfig:
+    stall_ticks: int = 200        # no-progress observations before STALLED
+    recompile_window: int = 8     # trace-count observations kept
+    recompile_limit: int = 3      # rises within the window -> storm
+    overflow_streak: int = 16     # consecutive overflow ticks -> DEGRADED
+    equivocation_limit: int = 0   # pruned blocks tolerated per node
+    dump_dir: Optional[str] = None  # None -> never write dump files
+
+
+class HealthWatchdog:
+    """Edge-triggered anomaly detectors over tick-loop observations."""
+
+    def __init__(self, cfg: WatchdogConfig = WatchdogConfig(),
+                 registry=None, recorder=None):
+        self.cfg = cfg
+        reg = registry if registry is not None else get_registry()
+        self._g_health = reg.gauge("watchdog_health")
+        self._c_anomalies = reg.counter("watchdog_anomalies_total")
+        self._recorder = recorder
+        # commit-stall state, per scope
+        self._last_commits: Dict[str, int] = {}
+        self._stalled_for: Dict[str, int] = {}
+        # recompile-storm state, per scope
+        self._traces: Dict[str, deque] = {}
+        # overflow-streak state, per scope
+        self._last_overflows: Dict[str, int] = {}
+        self._overflow_run: Dict[str, int] = {}
+        # equivocation state
+        self._equiv: Dict[int, int] = {}
+        self._active: Dict[str, str] = {}  # anomaly key -> reason
+        self._dumps = 0
+
+    # -- observations ----------------------------------------------------
+
+    def observe_commits(self, scope: str, own_commits: int,
+                        pending_ops: int) -> None:
+        """One tick's progress evidence for a pipeline scope."""
+        key = f"commit_stall:{scope}"
+        last = self._last_commits.get(scope)
+        self._last_commits[scope] = own_commits
+        if last is None or own_commits > last or pending_ops <= 0:
+            self._stalled_for[scope] = 0
+            self._clear(key)
+            return
+        n = self._stalled_for.get(scope, 0) + 1
+        self._stalled_for[scope] = n
+        if n >= self.cfg.stall_ticks:
+            self._raise(key, STALLED,
+                        f"{scope}: no commit for {n} ticks with "
+                        f"{pending_ops} ops pending")
+
+    def observe_trace_count(self, scope: str, trace_count: int) -> None:
+        """Feed the fused-path trace counter once per megatick."""
+        key = f"recompile_storm:{scope}"
+        dq = self._traces.setdefault(
+            scope, deque(maxlen=max(2, self.cfg.recompile_window)))
+        dq.append(int(trace_count))
+        rises = sum(1 for a, b in zip(dq, list(dq)[1:]) if b > a)
+        if rises >= self.cfg.recompile_limit:
+            self._raise(key, DEGRADED,
+                        f"{scope}: {rises} retraces in last "
+                        f"{len(dq)} megaticks")
+        else:
+            self._clear(key)
+
+    def observe_overflow(self, scope: str, overflows_total: int) -> None:
+        """Feed the cumulative delta-budget overflow counter per tick."""
+        key = f"overflow_streak:{scope}"
+        last = self._last_overflows.get(scope)
+        self._last_overflows[scope] = overflows_total
+        if last is None or overflows_total <= last:
+            self._overflow_run[scope] = 0
+            self._clear(key)
+            return
+        n = self._overflow_run.get(scope, 0) + 1
+        self._overflow_run[scope] = n
+        if n >= self.cfg.overflow_streak:
+            self._raise(key, DEGRADED,
+                        f"{scope}: delta budget overflowed "
+                        f"{n} consecutive ticks")
+
+    def observe_equivocation(self, counts: Dict[int, int]) -> None:
+        """Per-source pruned-block counts from the integrity plane."""
+        self._equiv = dict(counts)
+        bad = {src: n for src, n in counts.items()
+               if n > self.cfg.equivocation_limit}
+        key = "equivocation"
+        if bad:
+            worst = max(bad, key=bad.get)
+            self._raise(key, DEGRADED,
+                        f"node {worst}: {bad[worst]} pruned blocks "
+                        f"(limit {self.cfg.equivocation_limit})")
+        else:
+            self._clear(key)
+
+    # -- anomaly lifecycle -----------------------------------------------
+
+    def _raise(self, key: str, level: str, reason: str) -> None:
+        if key in self._active:
+            self._active[key] = f"{level}: {reason}"
+            return
+        self._active[key] = f"{level}: {reason}"
+        self._c_anomalies.add()
+        self._dump(key.split(":", 1)[0])
+
+    def _clear(self, key: str) -> None:
+        self._active.pop(key, None)
+
+    def _dump(self, anomaly: str) -> None:
+        """First-activation evidence capture: flight recorder -> disk."""
+        rec = (self._recorder if self._recorder is not None
+               else flight.get_recorder())
+        if not self.cfg.dump_dir or not rec.enabled:
+            return
+        self._dumps += 1
+        os.makedirs(self.cfg.dump_dir, exist_ok=True)
+        path = os.path.join(self.cfg.dump_dir,
+                            f"flight_{anomaly}_{self._dumps}.jsonl")
+        try:
+            rec.dump(path)
+        except OSError:
+            pass  # evidence capture must never take down the pipeline
+
+    # -- snapshot --------------------------------------------------------
+
+    def health(self) -> dict:
+        """Fold active anomalies into {status, reasons, ...}."""
+        level = OK
+        reasons: List[str] = []
+        for key, reason in sorted(self._active.items()):
+            reasons.append(f"{key} -> {reason}")
+            lv = reason.split(":", 1)[0]
+            if _LEVEL.get(lv, 1) > _LEVEL[level]:
+                level = lv
+        self._g_health.set(_LEVEL[level])
+        return {"status": level, "reasons": reasons,
+                "anomalies": len(self._active), "dumps": self._dumps,
+                "equivocation": dict(self._equiv)}

@@ -63,6 +63,7 @@ import numpy as np
 from janus_tpu.consensus import dag as dagmod
 from janus_tpu.consensus import tusk
 from janus_tpu.models import base
+from janus_tpu.obs import flight as obs_flight
 from janus_tpu.obs import stages as obs_stages
 from janus_tpu.obs.metrics import get_registry
 
@@ -174,6 +175,16 @@ class SafeKV:
         self.stage_scope = getattr(spec, "type_code",
                                    getattr(spec, "name", "kv"))
         self._stage = obs_stages.stage_histograms(self.stage_scope)
+        # causal tracing: the process flight recorder (disabled by
+        # default — every hook below is guarded on .enabled) and the
+        # live op->block map: (slot, node) -> trace_id, registered when
+        # a traced payload seals into a block, dropped at own-view
+        # commit or slot recycle. Block-level on purpose: a block is
+        # the unit the DAG orders, so every op riding it shares the
+        # block's consensus fate (the elected trace id is the block's
+        # representative op).
+        self._flight = obs_flight.get_recorder()
+        self._block_traces: Dict[tuple, str] = {}
         self._jit_submit = jax.jit(self._submit_device)
         self._jit_tick = jax.jit(self._tick_device)
         self._jit_step = jax.jit(self._step_device)
@@ -604,7 +615,7 @@ class SafeKV:
         metas = []
         for j in range(k):
             safe = None if safe_k is None else np.asarray(safe_k[j], bool)
-            metas.append((now, self.tick_count, safe, rec_mask))
+            metas.append((now, self.tick_count, safe, rec_mask, None))
             self.tick_count += 1
         return metas
 
@@ -716,12 +727,25 @@ class SafeKV:
         self.latency_log.extend(
             (tick_idx + 1 - self.submit_tick[newly]).tolist()
         )
+        fl = self._flight
+        traced_commits = []
         if newly.any():
             walls = (now - self.submit_wall[newly]).tolist()
             self.wall_latency_log.extend(walls)
             h_commit = self._stage["commit"]
             for wsec in walls:
                 h_commit.record_seconds(wsec)
+            if fl.enabled and self._block_traces:
+                t1w = time.time_ns()
+                for slot, v in zip(*np.nonzero(newly)):
+                    tid = self._block_traces.pop((int(slot), int(v)), None)
+                    if tid is None:
+                        continue
+                    wsec = now - self.submit_wall[slot, v]
+                    if not np.isfinite(wsec) or wsec < 0:
+                        wsec = 0.0
+                    fl.span_at(tid, "commit", t1w - int(wsec * 1e9), t1w)
+                    traced_commits.append(tid)
         for log in (self.latency_log, self.wall_latency_log):
             if len(log) > self.max_latency_log:
                 del log[: len(log) - self.max_latency_log]
@@ -731,6 +755,14 @@ class SafeKV:
             self.commit_tick[rec] = -1
             self.submit_wall[rec] = np.nan
             self.safe_host[rec] = False
+            if self._block_traces:
+                # a recycled slot's trace (committed ones popped above)
+                # died uncommitted — abandoned with its block
+                for key in [k for k in self._block_traces if rec[k[0]]]:
+                    tid = self._block_traces.pop(key)
+                    if fl.enabled:
+                        fl.event(tid, "recycled", "I",
+                                 detail=f"slot={key[0]}")
             if update_rounds:
                 # the step path never fetches slot_round; recycling adds
                 # exactly W to a slot's round, so mirror it incrementally
@@ -739,7 +771,12 @@ class SafeKV:
             # a GC advance is the coordination point where tombstones
             # whose ops left the window can be reclaimed
             self.maybe_compact()
-        self._stage["apply"].record(time.perf_counter_ns() - apply_t0)
+        apply_ns = time.perf_counter_ns() - apply_t0
+        self._stage["apply"].record(apply_ns)
+        if traced_commits:
+            t1w = time.time_ns()
+            for tid in traced_commits:
+                fl.span_at(tid, "apply", t1w - apply_ns, t1w)
         return newly
 
     def submit(self, ops: base.OpBatch, safe: Optional[np.ndarray] = None) -> np.ndarray:
@@ -823,7 +860,7 @@ class SafeKV:
     def step_dispatch(self, ops: base.OpBatch,
                       safe: Optional[np.ndarray] = None,
                       active=None, withhold=None, record=True,
-                      invalid=None):
+                      invalid=None, trace=None):
         """Fused submit+protocol-round in one async dispatch (no device
         sync). Returns ``(packed, meta)``; pass both to ``step_absorb``
         IN DISPATCH ORDER to complete host bookkeeping. A pipelined
@@ -840,7 +877,13 @@ class SafeKV:
         carry real client payload this tick: unmarked blocks (idle keep-
         alive rounds, drain phases) are excluded from latency logs and
         latency stats so they cannot dilute the op->commit metric or grow
-        host memory at idle."""
+        host memory at idle.
+
+        ``trace`` (optional length-N sequence of trace-id strings, None
+        entries allowed) names the causal trace each node's batch rides
+        under; accepted payload-bearing blocks register in the flight
+        recorder's op->block map so their seal / dag_round / commit /
+        apply legs land under the caller's trace id."""
         (self.prospective, self.stable, self.dag, self.commit,
          self.ops_buffer, self.buffer_filled, self.prosp_applied,
          self.stable_applied, self.force_transfer, packed) = self._jit_step(
@@ -856,7 +899,8 @@ class SafeKV:
         else:
             rec_mask = np.asarray(record, bool)
         meta = (time.perf_counter(), self.tick_count,
-                None if safe is None else np.asarray(safe, bool), rec_mask)
+                None if safe is None else np.asarray(safe, bool), rec_mask,
+                trace)
         self.tick_count += 1
         return packed, meta
 
@@ -866,7 +910,7 @@ class SafeKV:
         copy; ``observed_at`` is the wall time the fetch completed (for
         honest client-observable commit latency under pipelining).
         Returns {accepted[N], own[W,N], recycled[W], slot[N]}."""
-        stamp, tick_idx, safe, rec_mask = meta
+        stamp, tick_idx, safe, rec_mask, trace = meta
         if tick_idx != self._absorb_tick:
             raise RuntimeError(
                 f"step_absorb out of order: got tick {tick_idx}, "
@@ -899,6 +943,24 @@ class SafeKV:
         self.submit_wall[s[st], vs[st]] = stamp
         if safe is not None:
             self.safe_host[s[st], vs[st]] = safe[st]
+
+        fl = self._flight
+        if fl.enabled:
+            # wall-clock bounds of this dispatch->absorb interval (the
+            # recorder uses time_ns so jax.profiler device captures can
+            # be correlated by absolute time)
+            t1w = time.time_ns()
+            t0w = t1w - max(0, round_ns)
+            if trace is not None:
+                for v in np.nonzero(st)[0]:
+                    tid = trace[v]
+                    if tid:
+                        self._block_traces[(int(s[v]), int(v))] = tid
+                        fl.span_at(tid, "seal", t0w, t1w)
+            if self._block_traces:
+                # every traced block still in flight rode this round
+                for tid in self._block_traces.values():
+                    fl.span_at(tid, "dag_round", t0w, t1w)
 
         if self.collect_logs:
             # mirror tick()'s total-order bookkeeping from the packed
@@ -935,10 +997,11 @@ class SafeKV:
                 "round": pre_round.copy(), "slots_dropped": dropped}
 
     def step(self, ops: base.OpBatch, safe: Optional[np.ndarray] = None,
-             active=None, withhold=None, record=True, invalid=None) -> dict:
+             active=None, withhold=None, record=True, invalid=None,
+             trace=None) -> dict:
         """Synchronous fused step: one dispatch + one fetch per round."""
         packed, meta = self.step_dispatch(ops, safe, active, withhold, record,
-                                          invalid)
+                                          invalid, trace)
         return self.step_absorb(packed, meta)
 
     def safe_acks(self) -> np.ndarray:

@@ -5,44 +5,85 @@ Reference: profiling was ad hoc — commented-out per-message stopwatches
 in DAG.HandleMessage (DAG.cs:300-378) and offline dotnet-trace runs
 (paper §6.4). Here spans are first-class and cheap, and the device side
 defers to jax.profiler (XLA's own instrumentation) when a trace
-directory is given."""
+directory is given.
+
+Since the telemetry plane landed, ``Tracer`` is a thin veneer over it:
+each span name is backed by a registry histogram ``tracer_<name>_ns``
+(plus a ``tracer_<name>_max_ns`` ratchet gauge), so the same timings a
+``Tracer`` user collects also surface through the ``metrics`` service
+command and Prometheus scrape — one measurement path, two views. The
+old per-call ``List[float]`` accumulator is gone; ``report()`` keeps
+its shape (count / total_ms / mean_ms / max_ms) but now reads from the
+histograms, so ``mean_ms`` is exact and ``max_ms`` is the ratcheted
+maximum.
+"""
 from __future__ import annotations
 
 import contextlib
 import time
-from collections import defaultdict
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, Optional
+
+from janus_tpu.obs.metrics import _NAME_SAFE, get_registry
 
 
 class Tracer:
-    """Accumulates named span timings; ``report()`` -> per-span stats."""
+    """Accumulates named span timings; ``report()`` -> per-span stats.
 
-    def __init__(self) -> None:
-        self.spans: Dict[str, List[float]] = defaultdict(list)
+    Spans are registry histograms, namespaced per-instance when a
+    ``scope`` is given (``tracer_<scope>_<name>_ns``) so two Tracers
+    with a scope don't alias. Unscoped Tracers share the process-wide
+    ``tracer_<name>_ns`` family — same name, same series, which is the
+    point of unifying with the metrics plane.
+    """
+
+    def __init__(self, scope: str = "", registry=None) -> None:
+        self._reg = registry if registry is not None else get_registry()
+        self._prefix = f"tracer_{scope}_" if scope else "tracer_"
+        self._names: Dict[str, str] = {}  # span name -> metric base
+
+    def _base(self, name: str) -> str:
+        base = self._names.get(name)
+        if base is None:
+            base = self._prefix + _NAME_SAFE.sub("_", name)
+            self._names[name] = base
+        return base
 
     @contextlib.contextmanager
     def span(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
+        base = self._base(name)
+        h = self._reg.histogram(base + "_ns")
+        t0 = time.perf_counter_ns()
         try:
             yield
         finally:
-            self.spans[name].append(time.perf_counter() - t0)
+            dt = time.perf_counter_ns() - t0
+            h.record(dt)
+            self._reg.gauge(base + "_max_ns").max(dt)
 
     def report(self) -> Dict[str, Dict[str, float]]:
         out = {}
-        for name, xs in self.spans.items():
-            n = len(xs)
-            total = sum(xs)
+        for name, base in self._names.items():
+            h = self._reg.get(base + "_ns")
+            if h is None or h.count == 0:
+                continue
+            g = self._reg.get(base + "_max_ns")
             out[name] = {
-                "count": n,
-                "total_ms": round(1e3 * total, 3),
-                "mean_ms": round(1e3 * total / n, 3),
-                "max_ms": round(1e3 * max(xs), 3),
+                "count": h.count,
+                "total_ms": round(h.sum / 1e6, 3),
+                "mean_ms": round(h.sum / h.count / 1e6, 3),
+                "max_ms": round((g.value if g else 0.0) / 1e6, 3),
             }
         return out
 
     def clear(self) -> None:
-        self.spans.clear()
+        for base in self._names.values():
+            h = self._reg.get(base + "_ns")
+            if h is not None:
+                h.reset()
+            g = self._reg.get(base + "_max_ns")
+            if g is not None:
+                g.reset()
+        self._names.clear()
 
 
 @contextlib.contextmanager

@@ -102,6 +102,22 @@ def _record_cost_ns() -> float:
     return (time.perf_counter_ns() - t0) / n
 
 
+def _flight_event_cost_ns() -> float:
+    """Measured cost of one traced-pipeline event on this host: trace-id
+    mint (f-string) + FlightRecorder.span_at (index bump + tuple store)
+    — the whole per-event hot path the causal layer adds."""
+    import time
+
+    from janus_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=1024)
+    n = 200_000
+    t0 = time.perf_counter_ns()
+    for v in range(n):
+        rec.span_at(f"n{v & 15}.t{v}", "seal", 1000, 2000)
+    return (time.perf_counter_ns() - t0) / n
+
+
 def _hist_records() -> int:
     """Total record() calls absorbed by every histogram in the default
     registry (counter/gauge writes are per-batch, not per-record, so
@@ -145,12 +161,47 @@ def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
             f.flush()
             if overhead >= overhead_budget:
                 failures.append((name, overhead))
+
+        # flight-recorder overhead row: the light fixed-B preset again
+        # (its jit cache is warm from the loop above, so elapsed is
+        # stepping, not compiling) with causal tracing LIVE end to end.
+        # Same analytical form as the metrics check — at smoke geometry
+        # an A/B wall-clock diff measures jit jitter, not the recorder.
+        from janus_tpu.obs import flight as obs_flight
+
+        event_ns = _flight_event_cost_ns()
+        cfg = _smoke_cfg("orset_fixed_light", PRESETS["orset_fixed_light"])
+        rec = obs_flight.enable()
+        rec.clear()
+        t0 = time.perf_counter()
+        res = run(cfg)
+        elapsed = time.perf_counter() - t0
+        obs_flight.disable()
+        overhead = (rec.total * event_ns) / (elapsed * 1e9)
+        payload = res.to_dict()
+        payload["smoke"] = {
+            "elapsed_s": round(elapsed, 3),
+            "flight_events": rec.total,
+            "event_cost_ns": round(event_ns, 1),
+            "overhead_pct": round(100 * overhead, 4),
+        }
+        payload = {"run": "smoke_flight_overhead",
+                   "ts": round(time.time(), 1), **payload}
+        line = json.dumps(payload)
+        print(line, flush=True)
+        f.write(line + "\n")
+        f.flush()
+        if rec.total == 0:
+            failures.append(("flight_overhead(no events)", 1.0))
+        elif overhead >= 0.03:
+            failures.append(("flight_overhead", overhead))
     if failures:
         raise AssertionError(
             "telemetry fast-path overhead budget exceeded: " + ", ".join(
                 f"{n}: {100 * o:.2f}%" for n, o in failures))
-    print(f"# smoke OK: {len(PRESETS)} presets, overhead < "
-          f"{100 * overhead_budget:.0f}%", flush=True)
+    print(f"# smoke OK: {len(PRESETS)} presets + flight tracing, "
+          f"overhead < {100 * overhead_budget:.0f}% (flight < 3%)",
+          flush=True)
 
 
 def main() -> None:

@@ -1,0 +1,130 @@
+"""Flight recorder + causal trace tests (PR 3 tentpole).
+
+The recorder's contract: O(1) append into a preallocated ring — wrap
+keeps the NEWEST events and the buffer object never reallocates — and
+trace ids threaded through ``SafeKV.step`` land every pipeline leg
+(ingest -> seal -> dag_round -> commit -> apply) under one id, in a
+Perfetto-loadable Chrome trace export.
+"""
+import json
+import time
+
+import numpy as np
+
+from janus_tpu.obs import flight
+from janus_tpu.obs.flight import FlightRecorder
+from janus_tpu.obs.traceview import (
+    chrome_trace_json,
+    span_chains,
+    write_chrome_trace,
+)
+
+CHAIN = {"ingest", "seal", "dag_round", "commit", "apply"}
+
+
+def test_ring_wraparound_keeps_newest_never_reallocs():
+    rec = FlightRecorder(capacity=8)
+    buf_id = id(rec._buf)
+    for i in range(20):
+        rec.event(f"t{i}", "mark", "I", detail=i)
+    # the ring never grew and never swapped buffers
+    assert id(rec._buf) == buf_id
+    assert len(rec._buf) == 8
+    assert rec.total == 20
+    snap = rec.snapshot()
+    assert len(snap) == 8
+    # the 8 NEWEST events survive, returned oldest-first
+    assert [e[4] for e in snap] == list(range(12, 20))
+
+
+def test_span_context_manager_records_complete_span():
+    rec = FlightRecorder(capacity=4)
+    with rec.span("c1", "work"):
+        pass
+    (_t0, tid, span, kind, dur) = rec.snapshot()[0]
+    assert (tid, span, kind) == ("c1", "work", "S")
+    assert dur >= 0
+
+
+def test_disabled_recorder_records_nothing():
+    rec = FlightRecorder(capacity=4, enabled=False)
+    rec.event("x", "y")
+    rec.span_at("x", "y", 0, 5)
+    assert rec.total == 0
+    assert rec.snapshot() == []
+
+
+def test_dump_writes_json_lines(tmp_path):
+    rec = FlightRecorder(capacity=4)
+    rec.event("a", "m", "I", detail="d")
+    p = tmp_path / "f.jsonl"
+    assert rec.dump(str(p)) == 1
+    row = json.loads(p.read_text())
+    assert row["trace_id"] == "a"
+    assert row["span"] == "m"
+
+
+def test_chrome_trace_export_shape():
+    rec = FlightRecorder(capacity=16)
+    rec.span_at("c1", "seal", 1_000_000, 2_000_000)
+    rec.event("c1", "recycled", "I", detail="slot=3")
+    doc = json.loads(chrome_trace_json(rec.snapshot()))
+    evs = doc["traceEvents"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    insts = [e for e in evs if e["ph"] == "i"]
+    assert metas[0]["name"] == "thread_name"
+    assert metas[0]["args"]["name"] == "c1"
+    assert xs[0]["name"] == "seal"
+    assert xs[0]["ts"] == 1000.0 and xs[0]["dur"] == 1000.0  # us
+    assert insts[0]["name"] == "recycled"
+    assert insts[0]["args"]["detail"] == "slot=3"
+
+
+def test_causal_chain_through_safekv(tmp_path):
+    """A traced safe update's block shows the FULL pipeline chain —
+    ingest -> seal -> dag_round -> commit -> apply — under one trace id,
+    and the Perfetto export carries it."""
+    from janus_tpu.consensus import DagConfig
+    from janus_tpu.models import base, pncounter
+    from janus_tpu.runtime.safecrdt import SafeKV
+
+    rec = flight.enable()
+    rec.clear()
+    try:
+        n, B = 4, 8
+        kv = SafeKV(DagConfig(n, 8), pncounter.SPEC, ops_per_block=B,
+                    collect_logs=False, num_keys=16, num_writers=n)
+        rng = np.random.default_rng(0)
+        writer = np.broadcast_to(
+            np.arange(n, dtype=np.int32)[:, None], (n, B)).copy()
+        safe = np.ones((n, B), bool)
+        for t in range(40):
+            ops = base.make_op_batch(
+                op=np.full((n, B), pncounter.OP_INC, np.int32),
+                key=rng.integers(0, 16, (n, B)).astype(np.int32),
+                a0=np.ones((n, B), np.int32), writer=writer)
+            trace = [f"n{v}.t{t}" for v in range(n)]
+            t0 = time.time_ns()
+            for tid in trace:
+                rec.span_at(tid, "ingest", t0, time.time_ns())
+            kv.step(ops, safe=safe, record=True, trace=trace)
+    finally:
+        flight.disable()
+
+    chains = span_chains(rec.snapshot())
+    full = [tid for tid, spans in chains.items() if CHAIN <= set(spans)]
+    assert full, (
+        f"no complete causal chain among {len(chains)} traces; "
+        f"example chains: {dict(list(chains.items())[:4])}")
+    # the chain is causally ordered: ingest first, apply last
+    spans = chains[full[0]]
+    assert spans[0] == "ingest"
+    assert spans.index("seal") < spans.index("commit") < spans.index("apply")
+
+    out = tmp_path / "trace.json"
+    n_ev = write_chrome_trace(str(out), rec)
+    assert n_ev > 0
+    doc = json.loads(out.read_text())
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert CHAIN <= names

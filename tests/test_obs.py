@@ -146,6 +146,37 @@ def test_prometheus_histogram_cumulative_buckets():
     assert "h_ns_count 2" in text
 
 
+def test_prometheus_help_lines_precede_type():
+    reg = _populated_registry()
+    text = render_prometheus(reg)
+    lines = text.splitlines()
+    for name in ("ops_total", "block_size", "stage_test_commit_ns"):
+        hi = next(i for i, ln in enumerate(lines)
+                  if ln.startswith(f"# HELP {name} "))
+        ti = next(i for i, ln in enumerate(lines)
+                  if ln.startswith(f"# TYPE {name} "))
+        assert hi < ti
+    # the parser skips HELP comments and still round-trips
+    assert parse_prometheus(text)["ops_total"] == 42
+
+
+def test_prometheus_emits_zero_count_bucket_edges():
+    reg = Registry()
+    h = reg.histogram("h_ns")
+    h.record(0)     # bucket 0, edge le=1
+    h.record(100)   # bucket 7, edge le=128
+    text = render_prometheus(reg)
+    # EVERY edge up to the max observed bucket appears — including the
+    # zero-count ones in between, because scrape clients interpolate
+    # between ADJACENT emitted edges and a missing edge fakes precision
+    for i in range(8):
+        want = 1 if i < 7 else 2
+        assert f'h_ns_bucket{{le="{BUCKET_HI[i]}"}} {want}' in text
+    # ...and nothing beyond the observed range except +Inf
+    assert 'le="256"' not in text
+    assert 'h_ns_bucket{le="+Inf"} 2' in text
+
+
 def test_snapshot_json_shape():
     reg = _populated_registry()
     doc = json.loads(snapshot_json(reg))["metrics"]

@@ -29,7 +29,7 @@ def test_smoke_matrix_all_presets(tmp_path):
     mod.run_smoke(str(out))
 
     rows = [json.loads(line) for line in out.read_text().splitlines()]
-    assert len(rows) == len(PRESETS)
+    assert len(rows) == len(PRESETS) + 1  # + the flight-overhead row
     by_run = {r["run"]: r for r in rows}
     for name in PRESETS:
         row = by_run[f"smoke_{name}"]
@@ -40,7 +40,16 @@ def test_smoke_matrix_all_presets(tmp_path):
         if name != "rga":
             assert smoke["hist_records"] > 0, name
         assert smoke["overhead_pct"] < 2.0, name
-    # the adaptive presets must report their controller evidence
+    # the adaptive presets must report their controller evidence,
+    # including the per-stage mean/p90 the PR-3 satellite threaded in
     adaptive = by_run["smoke_orset_adaptive"]
     assert adaptive["block_ceiling"] >= adaptive["block_floor"]
     assert "stages" in adaptive and "commit" in adaptive["stages"]
+    assert "mean_ms" in adaptive["stages"]["commit"]
+    assert "p90_ms" in adaptive["stages"]["commit"]
+    # the clean delta run must come out healthy
+    assert by_run["smoke_mixed_delta"]["health"]["status"] == "OK"
+    # flight recorder: tracing was live (events flowed) and cheap
+    fl = by_run["smoke_flight_overhead"]["smoke"]
+    assert fl["flight_events"] > 0
+    assert fl["overhead_pct"] < 3.0
