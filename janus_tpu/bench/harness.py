@@ -90,6 +90,14 @@ class BenchConfig:
     offered_per_tick: int = 0
     block_floor: int = 64
     latency_target_ms: float = 50.0
+    # sharded wire mode (mode="wire_sharded"): worker count for the B
+    # arm (the A arm always runs shards=1 over the same schedule), and
+    # ops per columnar batch frame for the open-loop sender fleet
+    shards: int = 4
+    frame_ops: int = 2048
+    # op-accumulation threshold handed to JanusConfig.ingest_batch for
+    # both wire_sharded arms (0 = device round every service step)
+    ingest_batch: int = 0
     seed: int = 0
 
     @classmethod
@@ -904,6 +912,184 @@ def run_wire_native(cfg: BenchConfig) -> Results:
     return res
 
 
+def _wire_sharded_arm(cfg: BenchConfig, shards: int,
+                      schedule: Dict[str, object]) -> Dict[str, object]:
+    """One A/B arm of the sharded-wire benchmark: start a service with
+    ``shards`` workers, drive the SAME deterministic op schedule through
+    an open-loop BatchSender fleet (columnar batch frames, replies
+    drained off-thread and discarded), wait server-side until every op
+    is ingested and drained, then read back every key's value."""
+    import threading as _threading
+
+    from janus_tpu.net import JanusClient, JanusConfig, JanusService, TypeConfig
+    from janus_tpu.net.client import BatchSender
+
+    n_keys = int(schedule["n_keys"])
+    keys = [f"o{k}" for k in range(n_keys)]
+    svc = JanusService(JanusConfig(
+        num_nodes=cfg.num_nodes, window=cfg.window,
+        ops_per_block=cfg.ops_per_block, max_clients=cfg.clients + 8,
+        shards=shards, ingest_batch=cfg.ingest_batch,
+        types=(TypeConfig("pnc", {"num_keys": n_keys}),)))
+    port = svc.start()
+    arm: Dict[str, object] = {"shards": shards}
+    try:
+        pre = JanusClient("127.0.0.1", port, timeout=120)
+        for k in keys:
+            pre.request("pnc", k, "s", timeout=120)
+        # warmup frame per client: compiles every shard's device
+        # programs at the real batch shape; IDENTICAL in both arms, so
+        # its increments cancel in the A/B state comparison
+        warm = BatchSender("127.0.0.1", port)
+        warm.send_frame("pnc", keys, schedule["warm_idx"], "i",
+                        p0=schedule["warm_p0"])
+        time.sleep(1.0)  # close AFTER settling so the acks get sent
+        warm.close()
+        polls = [0]
+
+        def server_stats():
+            polls[0] += 1
+            return json.loads(
+                pre.request("stats", "_", "g", timeout=120)["result"])
+
+        stats0 = server_stats()
+        ops0 = stats0["ops_received"] - polls[0]
+        # reply lag floor: 1 for the stats request answering this very
+        # snapshot, plus any pre-run replies that died with a closed
+        # connection (none expected, but the check must not hang on one)
+        lag0 = stats0["ops_received"] - stats0["replies_sent"]
+        total = int(schedule["total_ops"])
+
+        # the fleet stays CONNECTED until the server drains: acks for
+        # an op sent on a closed connection are dropped unsent, which
+        # would both skew the reply-lag completion check and un-measure
+        # the reply half of the wire plane
+        senders = [BatchSender("127.0.0.1", port)
+                   for _ in schedule["per_client"]]
+
+        def drive(s, frames):
+            for idx, p0 in frames:
+                s.send_frame("pnc", keys, idx, "i", p0=p0)
+
+        threads = [_threading.Thread(target=drive, args=(s, fr))
+                   for s, fr in zip(senders, schedule["per_client"])]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        t_send = time.perf_counter()
+        # completion: every fleet op arrived, no op waiting in a shard
+        # inbox or a pending queue, and replies have caught up with
+        # ingest (reply lag 1 = only the current stats request itself
+        # unanswered — unsafe acks flush after their ops are staged, so
+        # a caught-up reply counter means every earlier op was boarded)
+        deadline = time.monotonic() + 300
+        while True:
+            st = server_stats()
+            arrived = st["ops_received"] - polls[0] - ops0
+            lag = st["ops_received"] - st["replies_sent"]
+            pending = st["types"]["pnc"].get("pending_ops", 0)
+            inbox = st.get("inbox_depth", 0)
+            if arrived >= total and lag <= lag0 and pending == 0 \
+                    and inbox == 0:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"sharded arm stalled: {arrived}/{total} arrived, "
+                    f"{pending} pending, {inbox} inboxed, lag {lag}")
+            time.sleep(0.025)
+        t_done = time.perf_counter()
+        for s in senders:
+            s.close()
+        arm["offered_ops_per_sec"] = round(total / (t_send - t0), 1)
+        arm["goodput_ops_per_sec"] = round(total / (t_done - t0), 1)
+        arm["elapsed_s"] = round(t_done - t0, 3)
+        # per-op dispatch cost from server-side step timing deltas (the
+        # wire_native formula); sharded arms average worker ticks
+        if "shards" in st:
+            ticks1 = float(np.mean(
+                [v["ticks"] for v in st["shards"].values()]))
+            ticks0 = float(np.mean(
+                [v["ticks"] for v in stats0["shards"].values()]))
+        else:
+            ticks1, ticks0 = st["ticks"], stats0["ticks"]
+        ticks_d = max(ticks1 - ticks0, 1)
+        ops_d = max(st["ops_received"] - stats0["ops_received"], 1)
+        arm["per_op_dispatch_us"] = round(
+            1e3 * st.get("step_ms_p50", 0.0) / max(ops_d / ticks_d, 1), 3)
+        arm["block_resizes"] = st["types"]["pnc"].get("block_resizes", 0)
+        # final state read-back (values, in key order) for the A/B gate
+        finals = []
+        for k in keys:
+            rep = pre.request("pnc", k, "gp", timeout=120)
+            finals.append(int(rep["result"]))
+        arm["finals"] = finals
+        pre.close()
+    finally:
+        svc.stop()
+    return arm
+
+
+def run_wire_sharded(cfg: BenchConfig) -> Results:
+    """Offered-load vs goodput A/B over the sharded service plane
+    (ISSUE 9): the SAME deterministic schedule of unsafe pnc updates —
+    columnar batch frames from an open-loop async client fleet — drives
+    an unsharded arm and a ``cfg.shards``-worker arm. The open-loop
+    fleet never waits on replies (BatchSender discards them on a drain
+    thread), so the goodput number measures the server plane, not the
+    driver; the closed-loop native loadgen (run_wire_native) stays as
+    the per-op-frame baseline. Gate: both arms must read back
+    BIT-EQUAL final values on every key, equal to the schedule's
+    predicted sums."""
+    res = Results(cfg)
+    rng = np.random.default_rng(cfg.seed)
+    n_keys = min(cfg.num_objects, 64)
+    frame_ops = max(64, cfg.frame_ops)
+    frames_per_client = max(1, cfg.ops_per_client // frame_ops)
+    per_client = []
+    expect = np.zeros(n_keys, np.int64)
+    for _c in range(cfg.clients):
+        frames = []
+        for _f in range(frames_per_client):
+            idx = rng.integers(0, n_keys, frame_ops).astype(np.int32)
+            p0 = rng.integers(1, 100, frame_ops).astype(np.int64)
+            np.add.at(expect, idx, p0)
+            frames.append((idx, p0))
+        per_client.append(frames)
+    warm_idx = rng.integers(0, n_keys, 256).astype(np.int32)
+    warm_p0 = rng.integers(1, 100, 256).astype(np.int64)
+    np.add.at(expect, warm_idx, warm_p0)
+    schedule = {
+        "n_keys": n_keys,
+        "per_client": per_client,
+        "warm_idx": warm_idx, "warm_p0": warm_p0,
+        "total_ops": cfg.clients * frames_per_client * frame_ops,
+    }
+    arm_a = _wire_sharded_arm(cfg, 1, schedule)
+    arm_b = _wire_sharded_arm(cfg, max(2, cfg.shards), schedule)
+    # the warmup frame runs once per arm, so both arms saw every
+    # scheduled op exactly once: totals must match the schedule exactly
+    expect_l = expect.tolist()
+    assert arm_a["finals"] == arm_b["finals"] == expect_l, (
+        "sharded/unsharded final states diverge:\n"
+        f"  unsharded: {arm_a['finals'][:8]}...\n"
+        f"  sharded:   {arm_b['finals'][:8]}...\n"
+        f"  expected:  {expect_l[:8]}...")
+    res.extra["states_bitequal"] = True
+    res.extra["arm_unsharded"] = {k: v for k, v in arm_a.items()
+                                  if k != "finals"}
+    res.extra["arm_sharded"] = {k: v for k, v in arm_b.items()
+                                if k != "finals"}
+    res.extra["shard_speedup"] = round(
+        arm_b["goodput_ops_per_sec"]
+        / max(arm_a["goodput_ops_per_sec"], 1e-9), 3)
+    res.extra["driver"] = "open-loop BatchSender fleet (columnar frames)"
+    res.total_ops = int(schedule["total_ops"])
+    res.elapsed_s = float(arm_b["elapsed_s"])
+    return res
+
+
 def run_rga_replay(cfg: BenchConfig) -> Results:
     """BASELINE config 5: collaborative-doc CHURN replay across emulated
     replicas — every tick each replica inserts (Lamport counters minted
@@ -1175,6 +1361,22 @@ PRESETS = {
                                num_objects=100, ops_per_block=4096,
                                clients=16, ops_per_client=60000,
                                pipeline=1024, ops_ratio=(0.3, 0.6, 0.1)),
+    # sharded service plane A/B (ISSUE 9): open-loop columnar batch
+    # frames drive shards=1 vs shards=2 over the same schedule; the
+    # per-op protobuf dispatch the wire_native preset pays (~2.6 us/op
+    # at its measured 269.7k) is what the frame path deletes
+    # small blocks on purpose: the ingest delta combiner collapses a
+    # whole poll's counter increments to <= num_objects lanes per home,
+    # so step cost tracks B (2.8 ms at B=128 vs 72 ms at B=4096), not
+    # the wire op count
+    "wire_sharded": BenchConfig(name="wire_pnc_sharded",
+                                mode="wire_sharded", type_code="pnc",
+                                num_nodes=4, num_objects=64,
+                                ops_per_block=256, clients=8,
+                                ops_per_client=131072, frame_ops=4096,
+                                shards=2, ingest_batch=65536,
+                                ops_ratio=(0.0, 1.0, 0.0),
+                                seed=11),
     # crash-fault pair (paper §6.2 Fig 11: 8 nodes, 0 vs 2 crashed);
     # window 16 on BOTH so the with/without-crash delta compares like
     # for like (see the byzantine note for why faults need the bigger
@@ -1195,6 +1397,8 @@ def run(cfg: BenchConfig) -> Results:
         return run_rga_replay(cfg)
     if cfg.mode == "wire_native":
         return run_wire_native(cfg)
+    if cfg.mode == "wire_sharded":
+        return run_wire_sharded(cfg)
     if cfg.mode == "adaptive":
         return run_tensor_adaptive(cfg)
     if cfg.mode == "store_delta":
@@ -1218,7 +1422,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", help="JSON BenchConfig file")
     ap.add_argument("--preset", choices=sorted(PRESETS), help="named preset")
-    ap.add_argument("--mode", choices=("tensor", "wire", "wire_native"))
+    ap.add_argument("--mode",
+                    choices=("tensor", "wire", "wire_native",
+                             "wire_sharded"))
     ap.add_argument("--json", action="store_true", help="emit JSON only")
     ap.add_argument("--trace-out", metavar="PATH",
                     help="enable the flight recorder for the run and "

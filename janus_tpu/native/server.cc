@@ -174,7 +174,96 @@ struct JanusServer {
 
   void io_loop();
   void handle_payload(uint32_t cid, const uint8_t* p, int len);
+  void handle_batch(uint32_t cid, const uint8_t* p, int len);
 };
+
+namespace {
+// unaligned little-endian loads (frame columns land at arbitrary
+// offsets; memcpy keeps this UB-free and compiles to a plain load)
+uint16_t le16(const uint8_t* p) { uint16_t v; memcpy(&v, p, 2); return v; }
+uint32_t le32(const uint8_t* p) { uint32_t v; memcpy(&v, p, 4); return v; }
+int32_t le32s(const uint8_t* p) { int32_t v; memcpy(&v, p, 4); return v; }
+int64_t le64s(const uint8_t* p) { int64_t v; memcpy(&v, p, 8); return v; }
+}  // namespace
+
+// Columnar batch frame: the wire half of the zero-copy ingest path.
+// One frame carries M same-type single-letter update ops as packed
+// little-endian arrays (the client builds them with numpy .tobytes()),
+// bulk-appended to the op queue without per-op protobuf parse or key
+// hashing. Layout after the field-0 length prefix:
+//   u8   magic = 0x00 (invalid as a protobuf tag: field 0 is illegal)
+//   u8   version = 1
+//   u8   tc_len;  bytes type_code
+//   u32  seq0     (op i's seq = seq0 + i; client bumps its seq by M)
+//   u16  n_keys;  n_keys x { u16 len; bytes name }  (frame-local dict)
+//   u32  M
+//   i32  key_idx[M]   (index into the frame's key dict)
+//   u8   op_code[M]   (single ASCII letter)
+//   u8   is_safe[M]
+//   i64  p0[M]
+void JanusServer::handle_batch(uint32_t cid, const uint8_t* p, int len) {
+  const uint8_t* end = p + len;
+  if (len < 3 || p[1] != 1) return;  // magic checked by caller
+  int tc_len = p[2];
+  p += 3;
+  if (p + tc_len + 4 + 2 > end) return;
+  std::string tc(reinterpret_cast<const char*>(p), size_t(tc_len));
+  p += tc_len;
+  uint32_t seq0 = le32(p);
+  p += 4;
+  int n_keys = le16(p);
+  p += 2;
+  std::vector<int32_t> slot_of(size_t(n_keys), -1);
+  int appended = 0;
+  {
+    std::lock_guard<std::mutex> lk(mu);
+    int tid = type_id_of(tc);
+    if (tid < 0) return;  // unknown type: drop, as the per-op path does
+    TypeSpace& ts = types[size_t(tid)];
+    for (int i = 0; i < n_keys; i++) {
+      if (p + 2 > end) return;
+      int kl = le16(p);
+      p += 2;
+      if (p + kl > end) return;
+      std::string key(reinterpret_cast<const char*>(p), size_t(kl));
+      p += kl;
+      auto it = ts.keys.find(key);
+      if (it != ts.keys.end()) {
+        slot_of[size_t(i)] = it->second;
+      } else if (int(ts.keys.size()) < ts.capacity) {
+        slot_of[size_t(i)] = int32_t(ts.keys.size());
+        ts.keys.emplace(key, slot_of[size_t(i)]);
+        ts.key_names.push_back(key);
+      }  // else -1: its ops drop, matching the per-op keyspace-full drop
+    }
+    if (p + 4 > end) return;
+    uint32_t m = le32(p);
+    p += 4;
+    // columns: i32 + u8 + u8 + i64 per op
+    if (uint64_t(end - p) < uint64_t(m) * 14) return;
+    const uint8_t* ki = p;
+    const uint8_t* oc = ki + size_t(m) * 4;
+    const uint8_t* sf = oc + m;
+    const uint8_t* pp = sf + m;
+    for (uint32_t i = 0; i < m; i++) {
+      int32_t kidx = le32s(ki + size_t(i) * 4);
+      if (kidx < 0 || kidx >= n_keys) continue;
+      int32_t slot = slot_of[size_t(kidx)];
+      if (slot < 0) continue;
+      Op op{};
+      op.type_id = tid;
+      op.key_slot = slot;
+      op.op_code = int32_t(oc[i]);
+      op.is_safe = sf[i] ? 1 : 0;
+      op.n_params = 1;
+      op.p[0] = le64s(pp + size_t(i) * 8);
+      op.client_tag = (uint64_t(cid) << 32) | ((seq0 + i) & 0xffffffff);
+      queue.push_back(op);
+      appended++;
+    }
+  }
+  if (appended) ops_in.fetch_add(appended, std::memory_order_relaxed);
+}
 
 void JanusServer::handle_payload(uint32_t cid, const uint8_t* p, int len) {
   Parsed m;
@@ -288,7 +377,11 @@ void JanusServer::io_loop() {
           if (used < 0) off = int(buf->size());  // malformed: drop buffer
           break;
         }
-        handle_payload(ids[i], buf->data() + off + poff, plen);
+        const uint8_t* pl = buf->data() + off + poff;
+        if (plen > 0 && pl[0] == 0x00)
+          handle_batch(ids[i], pl, plen);  // columnar batch frame
+        else
+          handle_payload(ids[i], pl, plen);
         off += used;
       }
       if (off > 0) buf->erase(buf->begin(), buf->begin() + off);
@@ -487,6 +580,27 @@ extern "C" int janus_server_reply_batch(JanusServer* s, int n,
     append_reply_frame(tags[i], ok[i], response_buf + response_off[i],
                        size_t(response_off[i + 1] - response_off[i]),
                        per_conn[cid]);
+    counts[cid]++;
+  }
+  int sent = 0;
+  for (auto& [cid, bytes] : per_conn)
+    if (send_to_conn(s, cid, bytes)) sent += counts[cid];
+  s->replies_out.fetch_add(sent, std::memory_order_relaxed);
+  return sent;
+}
+
+extern "C" int janus_server_reply_bulk(JanusServer* s, int n,
+                                       const uint64_t* tags, int ok,
+                                       const char* response) {
+  // one shared status/text for every tag (the unsafe-ack storm), same
+  // per-connection grouping + ordered append as reply_batch
+  size_t rl = response ? strlen(response) : 0;
+  const uint8_t* resp = reinterpret_cast<const uint8_t*>(response);
+  std::unordered_map<uint32_t, std::vector<uint8_t>> per_conn;
+  std::unordered_map<uint32_t, int> counts;
+  for (int i = 0; i < n; i++) {
+    uint32_t cid = uint32_t(tags[i] >> 32);
+    append_reply_frame(tags[i], ok, resp, rl, per_conn[cid]);
     counts[cid]++;
   }
   int sent = 0;

@@ -87,6 +87,10 @@ def load() -> ctypes.CDLL:
             c.c_void_p, c.c_int, u64p, u8p, u8p, i32p,
         ]
         lib.janus_server_reply_batch.restype = c.c_int
+        lib.janus_server_reply_bulk.argtypes = [
+            c.c_void_p, c.c_int, u64p, c.c_int, c.c_char_p,
+        ]
+        lib.janus_server_reply_bulk.restype = c.c_int
         for f in ("ops_received", "replies_sent"):
             getattr(lib, f"janus_server_{f}").argtypes = [c.c_void_p]
             getattr(lib, f"janus_server_{f}").restype = c.c_longlong
@@ -268,6 +272,22 @@ class NativeServer:
             buf.ctypes.data_as(c.POINTER(c.c_uint8)),
             off.ctypes.data_as(c.POINTER(c.c_int32)),
         )
+
+    def reply_bulk(self, tags: np.ndarray, ok: bool = True,
+                   text: str = "success") -> int:
+        """Send one identical reply (status + text) to every tag with a
+        single native call — the unsafe-update ack path. ``tags`` is a
+        uint64 array; per-connection frame grouping happens natively, so
+        the ~1 us/op Python tuple-and-encode walk of ``reply_batch``
+        never runs for the hot ack class."""
+        n = len(tags)
+        if n == 0:
+            return 0
+        tags = np.ascontiguousarray(tags, np.uint64)
+        return self._lib.janus_server_reply_bulk(
+            self._h, n,
+            tags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            1 if ok else 0, text.encode())
 
     def ops_received(self) -> int:
         return self._lib.janus_server_ops_received(self._h)

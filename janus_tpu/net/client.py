@@ -15,9 +15,12 @@ has exactly the reference's safe-update semantics
 from __future__ import annotations
 
 import socket
+import struct
 import threading
 import time
-from typing import Dict, Iterable, Optional
+from typing import Dict, Iterable, Optional, Sequence
+
+import numpy as np
 
 
 def _varint(v: int) -> bytes:
@@ -83,6 +86,35 @@ def frame0(payload: bytes) -> bytes:
     which is what the reference client/server speak on the client plane
     (ServerConnection.cs:51, ClientInterface.cs:56)."""
     return _varint(len(payload)) + payload
+
+
+def encode_batch_frame(seq0: int, type_code: str, keys: Sequence[str],
+                       key_idx: np.ndarray, op_codes: np.ndarray,
+                       is_safe: np.ndarray, p0: np.ndarray) -> bytes:
+    """One columnar batch-frame payload (server.cc handle_batch layout):
+    M same-type single-letter update ops as packed little-endian numpy
+    columns. Op i's wire sequence is ``seq0 + i``. The column bytes are
+    ``.tobytes()`` of the caller's arrays — no per-op encode loop, which
+    is what lets a Python client offer >1M ops/s."""
+    tc = type_code.encode()
+    head = bytearray()
+    head.append(0x00)            # magic: invalid as a protobuf tag
+    head.append(1)               # version
+    head.append(len(tc))
+    head.extend(tc)
+    head.extend(struct.pack("<I", seq0 & 0xFFFFFFFF))
+    head.extend(struct.pack("<H", len(keys)))
+    for k in keys:
+        kb = k.encode()
+        head.extend(struct.pack("<H", len(kb)))
+        head.extend(kb)
+    m = len(key_idx)
+    head.extend(struct.pack("<I", m))
+    return bytes(head) \
+        + np.ascontiguousarray(key_idx, np.int32).tobytes() \
+        + np.ascontiguousarray(op_codes, np.uint8).tobytes() \
+        + np.ascontiguousarray(is_safe, np.uint8).tobytes() \
+        + np.ascontiguousarray(p0, np.int64).tobytes()
 
 
 def decode_reply(payload: bytes) -> Dict[str, object]:
@@ -207,6 +239,33 @@ class JanusClient:
             self.sock.sendall(frame0(msg))
         return seq
 
+    def send_batch(self, type_code: str, keys: Sequence[str],
+                   key_idx, op_codes, p0=None, is_safe=None) -> range:
+        """Fire M single-letter update ops as ONE columnar batch frame
+        (one sendall, no per-op encode). ``keys`` is the frame-local key
+        dictionary; ``key_idx`` indexes into it per op; ``op_codes`` is
+        a single letter (broadcast) or a per-op uint8 array; ``p0`` the
+        int64 param column. Returns the ops' sequence range — each seq
+        gets a normal per-op reply, so ``wait`` works unchanged."""
+        key_idx = np.asarray(key_idx, np.int32)
+        m = len(key_idx)
+        if isinstance(op_codes, str):
+            op_codes = np.full(m, ord(op_codes), np.uint8)
+        p0 = (np.zeros(m, np.int64) if p0 is None
+              else np.asarray(p0, np.int64))
+        safe = (np.zeros(m, np.uint8) if is_safe is None
+                else np.asarray(is_safe).astype(np.uint8))
+        with self._lock:
+            seq0 = self._seq + 1
+            self._seq += m
+            for i in np.nonzero(safe)[0].tolist():
+                self._safe_seqs.add(seq0 + int(i))
+        payload = encode_batch_frame(seq0, type_code, keys, key_idx,
+                                     op_codes, safe, p0)
+        with self._send_lock:
+            self.sock.sendall(frame0(payload))
+        return range(seq0, seq0 + m)
+
     def wait(self, seq: int, timeout: Optional[float] = None) -> Dict[str, object]:
         """Block until the reply for ``seq`` arrives. Returns
         ``{seq, result, response}`` — ``result`` is the value/error text,
@@ -286,6 +345,69 @@ class JanusClient:
         import json
         return json.loads(str(
             self.request("trace", "_", "g", timeout=timeout)["result"]))
+
+    def close(self):
+        self._closed = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class BatchSender:
+    """Open-loop batched wire driver: fires columnar frames without
+    waiting for replies, and a drain thread counts-and-discards the
+    reply stream (parsing every reply in Python would throttle the
+    offered load back into a closed loop — the bench measures goodput
+    from the server's replies_sent counter instead).
+
+    The drain thread is NOT optional: the service's native reply send
+    blocks on a full client TCP buffer, so an un-drained sender would
+    wedge the whole reply flush."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._seq = 0
+        self._closed = False
+        self.reply_bytes = 0
+        self._rx = threading.Thread(target=self._drain, daemon=True)
+        self._rx.start()
+
+    def _drain(self):
+        while not self._closed:
+            try:
+                chunk = self.sock.recv(1 << 18)
+            except OSError:
+                break
+            if not chunk:
+                break
+            self.reply_bytes += len(chunk)
+
+    def send_frame(self, type_code: str, keys: Sequence[str], key_idx,
+                   op_codes, p0=None, is_safe=None) -> int:
+        """Send one columnar batch frame; returns the op count."""
+        key_idx = np.asarray(key_idx, np.int32)
+        m = len(key_idx)
+        if isinstance(op_codes, str):
+            op_codes = np.full(m, ord(op_codes), np.uint8)
+        p0 = (np.zeros(m, np.int64) if p0 is None
+              else np.asarray(p0, np.int64))
+        safe = (np.zeros(m, np.uint8) if is_safe is None
+                else np.asarray(is_safe).astype(np.uint8))
+        seq0 = self._seq + 1
+        self._seq += m
+        payload = encode_batch_frame(seq0, type_code, keys, key_idx,
+                                     op_codes, safe, p0)
+        self.sock.sendall(frame0(payload))
+        return m
 
     def close(self):
         self._closed = True

@@ -45,7 +45,7 @@ from janus_tpu.obs.export import render_prometheus
 from janus_tpu.obs.traceview import chrome_trace_json
 from janus_tpu.obs.watchdog import HealthWatchdog, WatchdogConfig
 from janus_tpu.ops.lattice import SENTINEL
-from janus_tpu.runtime.keyspace import ReplicatedKeySpace
+from janus_tpu.runtime.keyspace import ReplicatedKeySpace, shard_of
 from janus_tpu.runtime.safecrdt import SafeKV
 from janus_tpu.utils.ids import Interner, TagMinter
 from janus_tpu.utils.perf import PerfCounter
@@ -99,6 +99,24 @@ class JanusConfig:
     bind_addr: str = "127.0.0.1"
     port: int = 0  # 0 -> ephemeral
     max_clients: int = 64
+    # sharded service plane: >1 splits the keyspace over that many
+    # worker services (shard_of(type_code, key) -> worker), each owning
+    # its own emulated cluster + megatick and stepping on its own
+    # thread; the front-end thread only polls the wire and routes.
+    # shards=1 IS the unsharded service (no front-end, no workers).
+    shards: int = 1
+    # pin each shard's device state to jax.devices()[shard % ndev] —
+    # one mesh member per shard, so shard programs run on distinct
+    # devices and their steps overlap (parallel/mesh.py)
+    shard_devices: bool = False
+    # op accumulation: defer the device round while ONLY ingest-acked
+    # update work is pending (no reads, no safe acks or creates in
+    # flight) until this many client ops accumulate or ingest_wait_ms
+    # passes — a consensus round costs the same milliseconds for 100
+    # ops as for 100k, so stepping per tiny poll wastes the device.
+    # 0 = step every round (legacy behavior).
+    ingest_batch: int = 0
+    ingest_wait_ms: float = 10.0
     # health watchdog: consecutive no-commit steps (with ops pending)
     # before the service reports STALLED
     watchdog_stall_ticks: int = 200
@@ -146,6 +164,10 @@ class JanusConfig:
             bind_addr=raw.get("bind_addr", "127.0.0.1"),
             port=int(raw.get("port", 0)),
             max_clients=int(raw.get("max_clients", 64)),
+            shards=int(raw.get("shards", 1)),
+            shard_devices=bool(raw.get("shard_devices", False)),
+            ingest_batch=int(raw.get("ingest_batch", 0)),
+            ingest_wait_ms=float(raw.get("ingest_wait_ms", 10.0)),
             watchdog_stall_ticks=int(raw.get("watchdog_stall_ticks", 200)),
             flight_dump_dir=raw.get("flight_dump_dir", ""),
             log_level=raw.get("log_level", "info"),
@@ -160,7 +182,8 @@ class _TypeRuntime:
     In split mode the cluster is a SplitNode (owned nodes + signed wire,
     net/splitnode.py) whose SafeKV this runtime reads through."""
 
-    def __init__(self, cfg: JanusConfig, tcfg: TypeConfig, send=None):
+    def __init__(self, cfg: JanusConfig, tcfg: TypeConfig, send=None,
+                 scope_suffix: str = ""):
         spec = base.get_type(tcfg.type_code)
         dims = dict(tcfg.dims)
         if tcfg.type_code in ("pnc", "mvr"):
@@ -219,6 +242,10 @@ class _TypeRuntime:
         # device-resident zero batch for idle keep-alive rounds (rebuilt
         # host uploads every tick would ride each idle dispatch)
         self.idle_batch = None
+        # consecutive payload-free rounds; past the trailing commit
+        # window (with nothing awaiting a commit) keep-alive steps stop
+        self.idle_rounds = 0
+        self.last_payload_t = time.perf_counter()
         # AIMD block-size controller (split mode keeps fixed B: peers
         # would disagree on block geometry without a resize protocol)
         self.sched = None
@@ -232,7 +259,7 @@ class _TypeRuntime:
                     grow_step=max(64, cfg.ops_per_block // 8),
                 ),
                 b0=cfg.ops_per_block,
-                scope=f"sched_{tcfg.type_code}")
+                scope=f"sched_{tcfg.type_code}{scope_suffix}")
             self.sched_target: Optional[int] = None
 
     # op-code letters for this type (e.g. {"i": 1, "d": 2})
@@ -248,9 +275,7 @@ class _TypeRuntime:
             "base_round": self.kv.base_round(),
             "commit_lag_ticks_p50":
                 float(np.percentile(lat, 50)) if lat.size else None,
-            "pending_ops": sum(
-                len(e[1]["tag"]) if e[0] == "chunk" else 1
-                for q in self.pending for e in q),
+            "pending_ops": _pending_total(self.pending),
         }
         if "element_count" in self.spec.queries:
             # slot-capacity pressure (tombstones included): how close the
@@ -262,22 +287,218 @@ class _TypeRuntime:
         return snap
 
 
+def _entry_ops(e) -> int:
+    """Client-op count of one pending-queue entry. Columnar chunks carry
+    one lane per op, except combined counter chunks whose lanes absorb
+    many wire ops — those record their original count under "nops" so
+    backlog gauges and read-barrier stats keep counting client ops, not
+    device lanes."""
+    if e[0] != "chunk":
+        return 1
+    cols = e[1]
+    return cols.get("nops", len(cols["tag"]))
+
+
+def _combine_lanes(cols: Dict[str, np.ndarray],
+                   limit: int) -> Optional[Dict[str, np.ndarray]]:
+    """Collapse the UNSAFE lanes of a pnc column set per (op, key) into
+    one lane carrying the summed amount (int64 accumulation, split into
+    multiple lanes above the int32 lane cap); safe lanes pass through
+    in order at the front. Returns None when the combined form would
+    exceed ``limit`` lanes (the caller's guaranteed block capacity).
+    The first contributor donates each lane's representative tag (only
+    read for trace labels)."""
+    safe = cols["safe"]
+    u = ~safe
+    s_idx = np.nonzero(safe)[0]
+    code = (cols["op"][u].astype(np.int64) << 32) | cols["key"][u]
+    uniq, first = np.unique(code, return_index=True)
+    sums = np.zeros(len(uniq), np.int64)
+    np.add.at(sums, np.searchsorted(uniq, code),
+              cols["a0"][u].astype(np.int64))
+    reps = cols["tag"][u][first]
+    cap = 2**31 - 1  # device lanes are int32; split larger sums
+    ops_l, keys_l, a0_l, tag_l = [], [], [], []
+    for i, tot in enumerate(sums.tolist()):
+        while True:
+            part = min(tot, cap)
+            ops_l.append(int(uniq[i]) >> 32)
+            keys_l.append(int(uniq[i]) & 0xFFFFFFFF)
+            a0_l.append(part)
+            tag_l.append(int(reps[i]))
+            tot -= part
+            if tot <= 0:
+                break
+    nc = len(ops_l)
+    if len(s_idx) + nc > limit:
+        return None
+    return {
+        "op": np.concatenate(
+            [cols["op"][s_idx], np.asarray(ops_l, np.int32)]),
+        "key": np.concatenate(
+            [cols["key"][s_idx], np.asarray(keys_l, np.int32)]),
+        "a0": np.concatenate(
+            [cols["a0"][s_idx], np.asarray(a0_l, np.int32)]),
+        "a1": np.concatenate(
+            [cols["a1"][s_idx], np.zeros(nc, np.int32)]),
+        "a2": np.concatenate(
+            [cols["a2"][s_idx], np.zeros(nc, np.int32)]),
+        "safe": np.concatenate(
+            [np.ones(len(s_idx), bool), np.zeros(nc, bool)]),
+        "tag": np.concatenate(
+            [cols["tag"][s_idx], np.asarray(tag_l, np.uint64)]),
+    }
+
+
+def _merge_combined(a: dict, b: dict, limit: int) -> Optional[dict]:
+    """Merge two adjacent COMBINED chunks queued on the same home into
+    one (commuting unsafe lanes re-combine per (op, key); safe lanes
+    concatenate in order). Without this, op accumulation would pile up
+    many small atomic chunks of which only B/limit board per device
+    round — merging keeps 'one consensus round per backlog' true no
+    matter how many polls fed it. Returns None if the merged form would
+    exceed ``limit`` lanes (callers then queue ``b`` separately)."""
+    cat = {f: np.concatenate([a[f], b[f]])
+           for f in ("op", "key", "a0", "a1", "a2", "safe", "tag")}
+    out = _combine_lanes(cat, limit)
+    if out is None:
+        return None
+    pc = np.concatenate([a["pend"][0], b["pend"][0]])
+    pk = np.concatenate([a["pend"][1], b["pend"][1]])
+    uc, inv = np.unique(pc, return_inverse=True)
+    cnts = np.zeros(len(uc), np.int64)
+    np.add.at(cnts, inv, pk)
+    out["pend"] = (uc, cnts)
+    out["nops"] = a["nops"] + b["nops"]
+    return out
+
+
+def _pending_total(queues) -> int:
+    """Sum client-op counts across pending queues, tolerating concurrent
+    mutation: the front-end serves `stats`/`metrics` against LIVE worker
+    state, so the owning worker may board/requeue mid-iteration. tuple()
+    snapshots at C speed (tiny race window); on the rare collision we
+    retry, and fall back to the entry count — approximate beats a dead
+    reply."""
+    for _ in range(8):
+        try:
+            return sum(_entry_ops(e) for q in queues for e in tuple(q))
+        except RuntimeError:  # deque mutated during iteration
+            continue
+    return sum(len(q) for q in queues)
+
+
 def _letters(op_code: int) -> str:
     s = chr(op_code & 0xFF)
     hi = (op_code >> 8) & 0xFF
     return s + (chr(hi) if hi else "")
 
 
-class JanusService:
-    """One process hosting the full emulated cluster + client plane."""
+# minimum wire ops polled per step regardless of block geometry: the
+# delta combiner decouples device cost from polled-op count, so a small
+# adaptive block must not throttle intake (pre-combiner the cap tracked
+# one full round of blocks)
+_POLL_FLOOR = 65536
 
-    def __init__(self, cfg: JanusConfig = JanusConfig()):
+# poll_batch column schema: a drained empty inbox must hand the worker
+# the same dict shape the native poll does
+_POLL_FIELDS = (
+    ("type_id", np.int32), ("key_slot", np.int32), ("op_code", np.int32),
+    ("is_safe", np.uint8), ("n_params", np.int32), ("p0", np.int64),
+    ("p1", np.int64), ("p2", np.int64), ("client_tag", np.uint64),
+)
+
+
+# cross-shard type-stats merge policy: counters (the default) sum;
+# structural keys are minima / maxima / shared constants instead
+_STATS_MIN = frozenset({"base_round"})
+_STATS_MAX = frozenset({"max_slot_occupancy", "ticks",
+                        "commit_lag_ticks_p50"})
+_STATS_SAME = frozenset({"slot_capacity"})
+
+
+def _merge_type_stats(snaps: List[dict]) -> dict:
+    """Fold one type's per-shard stats snapshots into a single dict of
+    the same shape (the `stats` command merge)."""
+    out: Dict[str, object] = {}
+    for k in snaps[0]:
+        vals = [s.get(k) for s in snaps]
+        nums = [v for v in vals
+                if isinstance(v, (int, float)) and not isinstance(v, bool)]
+        if not nums or k in _STATS_SAME:
+            out[k] = vals[0]
+        elif k in _STATS_MIN:
+            out[k] = min(nums)
+        elif k in _STATS_MAX:
+            out[k] = max(nums)
+        else:
+            out[k] = type(nums[0])(sum(nums))
+    return out
+
+
+class _ShardInbox:
+    """Front-end -> shard-worker op channel: the router appends column
+    chunks (already COPIED out of the native poll buffers — those are
+    reused next poll), the worker drains everything at its next step.
+    One lock, two list swaps; depth is kept incrementally so the
+    queue-depth gauge never walks the chunks."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._chunks: List[Dict[str, np.ndarray]] = []
+        self.depth = 0  # ops currently queued (racy read is fine)
+
+    def put(self, cols: Dict[str, np.ndarray]) -> None:
+        with self._lock:
+            self._chunks.append(cols)
+            self.depth += len(cols["client_tag"])
+
+    def drain(self) -> Dict[str, np.ndarray]:
+        with self._lock:
+            chunks, self._chunks = self._chunks, []
+            self.depth = 0
+        if not chunks:
+            return {f: np.empty(0, dt) for f, dt in _POLL_FIELDS}
+        if len(chunks) == 1:
+            return chunks[0]
+        return {f: np.concatenate([c[f] for c in chunks])
+                for f, _ in _POLL_FIELDS}
+
+
+class JanusService:
+    """One process hosting the full emulated cluster + client plane.
+
+    With ``cfg.shards > 1`` this instance is the FRONT-END: it owns the
+    native server, polls the wire, and routes each op to one of
+    ``shards`` worker JanusService instances by
+    ``shard_of(type_code, key_name)`` (runtime/keyspace.py). Each
+    worker owns its keyspace partition outright — its own emulated
+    cluster per type, its own megatick, its own pump thread — so no op
+    for a key ever touches two shards and read-your-writes holds
+    per-key exactly as in the unsharded service. Worker device steps
+    release the GIL inside XLA, so one worker's Python dispatch
+    overlaps another's device compute even on one host core.
+    ``shards=1`` takes none of these paths and behaves bit-identically
+    to the pre-sharding service."""
+
+    def __init__(self, cfg: JanusConfig = JanusConfig(),
+                 _server: Optional[NativeServer] = None,
+                 _shard: Optional[Tuple[int, "_ShardInbox"]] = None):
         self.cfg = cfg
         from janus_tpu.utils.log import configure, get_logger
         configure(cfg.log_level, proc=f"p{cfg.proc_index}"
                   if cfg.split else None)
         self.log = get_logger("service")
-        self.server = NativeServer(cfg.bind_addr, cfg.port, cfg.max_clients)
+        if cfg.shards > 1 and cfg.split:
+            raise ValueError("shards > 1 is incompatible with a split "
+                             "cluster (procs): one partitions the "
+                             "keyspace, the other the node set")
+        # worker identity: (shard index, inbox fed by the front-end)
+        self._shard_id, self._inbox = _shard if _shard else (None, None)
+        self._front = cfg.shards > 1 and _shard is None
+        self._owns_server = _server is None
+        self.server = _server if _server is not None else NativeServer(
+            cfg.bind_addr, cfg.port, cfg.max_clients)
         self.types: Dict[int, _TypeRuntime] = {}
         self._interner = Interner()
         # client home nodes: every node locally, or this process's owned
@@ -300,13 +521,23 @@ class JanusService:
         self._fast_ops: Dict[int, np.ndarray] = {}
         self._fast_kind: Dict[int, str] = {}
         self._homes_np = np.asarray(cfg.owned, np.int64)
+        # worker runtimes carry the shard index in every telemetry
+        # scope so per-shard schedulers/watchdogs never collide in the
+        # process-wide registry; shards=1 keeps the bare names
+        sfx = (f"_s{self._shard_id}" if self._shard_id is not None
+               and cfg.shards > 1 else "")
         for i, tcfg in enumerate(cfg.types):
+            # native type registration is idempotent — front-end and
+            # every worker register the same codes and observe the same
+            # tids, so routed column chunks need no tid translation
             tid = self.server.register_type(tcfg.type_code, tcfg.num_keys)
+            self._tid_order.append(tid)
+            if self._front:
+                continue  # front-end routes; workers own the runtimes
             send = self._fabric.type_sender(i) if self._fabric else None
-            rt = _TypeRuntime(cfg, tcfg, send=send)
+            rt = _TypeRuntime(cfg, tcfg, send=send, scope_suffix=sfx)
             rt.index = i
             self.types[tid] = rt
-            self._tid_order.append(tid)
             if tcfg.type_code in ("pnc", "orset", "lww", "tpset", "mvr"):
                 tbl = np.full(256, -1, np.int32)
                 for letters, opid in rt.spec.op_codes.items():
@@ -321,7 +552,7 @@ class JanusService:
         # health snapshot + flight-recorder fetch, same in-band shape
         self._health_tid = self.server.register_type("health", 1)
         self._trace_tid = self.server.register_type("trace", 1)
-        self._h_ingest = obs_stages.stage_histograms("svc")["ingest"]
+        self._h_ingest = obs_stages.stage_histograms(f"svc{sfx}")["ingest"]
         # liveness watchdog fed once per step per type; dumps the flight
         # recorder on first anomaly when a dump dir is configured
         self.watchdog = HealthWatchdog(WatchdogConfig(
@@ -365,6 +596,51 @@ class JanusService:
         # per-step staging: (tid, home) -> [(arrival pos, queue entry)];
         # flushed sorted so per-item and columnar ingest keep one FIFO
         self._stage: Dict[Tuple[int, int], List[Tuple[int, tuple]]] = {}
+        # uniform-success acks (unsafe updates, repeat creates) flush
+        # through the native bulk path: one shared reply rendered once,
+        # fanned per connection in C (reply_bulk) instead of a Python
+        # tuple + frame encode per op
+        self._ack_bulk: List[np.ndarray] = []
+        # packed 2-letter read op codes (gp/gs/sp/ss) for the batched
+        # read decode in _ingest_columnar
+        self._read_opcs = np.asarray(
+            [ord(a) | (ord(b) << 8) for a, b in ("gp", "gs", "sp", "ss")],
+            np.int32)
+        self._read_letters = {int(c): l for c, l in zip(
+            self._read_opcs.tolist(), ("gp", "gs", "sp", "ss"))}
+
+        # -- shard plane -------------------------------------------------
+        self._shard_m = None
+        self._last_step_end: Optional[float] = None
+        # wall clock of the last completed device round (op-accumulation
+        # wait budget measures from here)
+        self._last_round_t = time.perf_counter()
+        if self._inbox is not None:
+            self._shard_m = obs_metrics.shard_instruments(self._shard_id)
+            if cfg.shard_devices:
+                from janus_tpu.parallel.mesh import pin_kv_to_device
+                import jax
+                devs = jax.devices()
+                dev = devs[self._shard_id % len(devs)]
+                for rt in self.types.values():
+                    pin_kv_to_device(rt.kv, dev)
+        self.workers: List["JanusService"] = []
+        if self._front:
+            # native key slot -> owning shard, resolved lazily by key
+            # NAME (slot interning order is connection-arrival order;
+            # shard_of hashes the name so placement is stable across
+            # restarts and independent of arrival order)
+            self._shard_lut: Dict[int, np.ndarray] = {}
+            self._tid_code: Dict[int, str] = {}
+            for tid, tcfg in zip(self._tid_order, cfg.types):
+                self._shard_lut[tid] = np.full(tcfg.num_keys, -1, np.int32)
+                self._tid_code[tid] = tcfg.type_code
+            self._ctrl_tids = np.asarray(
+                [self._stats_tid, self._metrics_tid, self._health_tid,
+                 self._trace_tid], np.int32)
+            for k in range(cfg.shards):
+                self.workers.append(JanusService(
+                    cfg, _server=self.server, _shard=(k, _ShardInbox())))
 
     # -- lifecycle -------------------------------------------------------
 
@@ -373,11 +649,13 @@ class JanusService:
         ``pump=False``, a driver thread calling ``step`` continuously.
         In split mode this first completes the DAG-plane mesh
         (connect-all with retries) and broadcasts key material."""
-        port = self.server.start()
+        port = self.server.start() if self._owns_server else self.server.port
         if self._fabric is not None:
             self._fabric.start()
             for rt in self.types.values():
                 rt.node.start()
+        for w in self.workers:
+            w.start(pump=pump, interval=interval)
         if pump:
             self._running = True
             self._thread = threading.Thread(
@@ -404,9 +682,12 @@ class JanusService:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        for w in self.workers:
+            w.stop()
         if self._fabric is not None:
             self._fabric.close()
-        self.server.close()
+        if self._owns_server:
+            self.server.close()
 
     # -- split-cluster plumbing -----------------------------------------
 
@@ -487,6 +768,12 @@ class JanusService:
         self._reply_buf.append((tag, result, status))
 
     def _flush_replies(self) -> None:
+        # bulk acks first: for a pipelining connection the acks are for
+        # ops that arrived BEFORE anything answered via _reply this step
+        if self._ack_bulk:
+            bulks, self._ack_bulk = self._ack_bulk, []
+            for arr in bulks:
+                self.server.reply_bulk(arr)
         if self._reply_buf:
             buf, self._reply_buf = self._reply_buf, []
             self.server.reply_batch(buf)
@@ -507,6 +794,8 @@ class JanusService:
         """Drain the native queue, execute one protocol round, send
         replies. Returns True if any client work was processed."""
         try:
+            if self._front:
+                return self._route_step()
             return self._step_inner()
         finally:
             # flush even when the step raises: replies already queued
@@ -524,19 +813,31 @@ class JanusService:
         # a B=8192 geometry left blocks 1/8 full while paying the full
         # device-step cost (the cap, not the device, set the ceiling)
         t_ingest = time.perf_counter_ns()
-        polled = self.server.poll_batch(
-            min(65536, max(4096, n * self.cfg.ops_per_block)))
+        if self._inbox is not None:
+            # shard worker: ops arrive pre-routed from the front-end
+            now_pc = time.perf_counter()
+            if self._last_step_end is not None:
+                self._shard_m["step_lag"].set(
+                    round(1e3 * (now_pc - self._last_step_end), 3))
+            self._shard_m["queue_depth"].set(self._inbox.depth)
+            polled = self._inbox.drain()
+        else:
+            polled = self.server.poll_batch(
+                min(65536, max(_POLL_FLOOR,
+                               n * self.cfg.ops_per_block)))
         count = len(polled["client_tag"])
         slow_idx = None
+        reads: List[dict] = []
         if count:
             self.perf.add(count)
-            slow_idx = self._ingest_columnar(polled)
+            if self._shard_m is not None:
+                self._shard_m["ops_total"].add(count)
+            slow_idx = self._ingest_columnar(polled, reads)
         waiting = self._waiting
         self._waiting = []
         for it in waiting:
             # re-ingestion below re-counts any item that stays queued
             self._pend_dec(it["tag"])
-        reads: List[dict] = []
         # waiting items are older than anything in this poll: negative
         # arrival positions sort them ahead at the stage flush
         for j, it in enumerate(waiting):
@@ -583,15 +884,44 @@ class JanusService:
                         elif e[3]:  # ("item", fields, tag, safe, ckey)
                             fl.span_at(f"c{int(e[2])}", "ingest",
                                        t0w, t1w)
+            limit = min(self.cfg.block_floor, self.cfg.ops_per_block)
             for (tid, v), lst in self._stage.items():
                 lst.sort(key=lambda e: e[0])
                 q = self.types[tid].pending[v]
                 for _pos, e in lst:
+                    # adjacent combined chunks merge in place (they
+                    # board atomically, so the queue tail is whole)
+                    if (e[0] == "chunk" and "pend" in e[1] and q
+                            and q[-1][0] == "chunk"
+                            and "pend" in q[-1][1]):
+                        merged = _merge_combined(q[-1][1], e[1], limit)
+                        if merged is not None:
+                            q[-1] = ("chunk", merged)
+                            continue
                     q.append(e)
             self._stage.clear()
         if count:
             # measured ingest leg: wire poll -> staged on runtime queues
             self._h_ingest.record(time.perf_counter_ns() - t_ingest)
+
+        # op accumulation: when everything pending is ingest-acked
+        # update work (reads, safe acks, and creates all force a round),
+        # hold off the device until a block's worth of client ops has
+        # gathered or the wait budget expires — the round costs the same
+        # milliseconds either way, so this is what turns many tiny polls
+        # into one consensus round under bursty wire load
+        if (self.cfg.ingest_batch > 0 and not reads
+                and not self._deferred_reads and not self._waiting
+                and time.perf_counter() - self._last_round_t
+                    < self.cfg.ingest_wait_ms * 1e-3
+                and all(not rt.ack_map and not rt.create_tags
+                        for rt in self.types.values())
+                and sum(_pending_total(rt.pending)
+                        for rt in self.types.values())
+                    < self.cfg.ingest_batch):
+            if self._shard_m is not None:
+                self._last_step_end = time.perf_counter()
+            return count > 0
 
         # ride pending work on each node's next block, advance one round,
         # materialize committed key creates, send deferred safe acks
@@ -603,10 +933,12 @@ class JanusService:
             # liveness evidence: ops pending with no own-view commit
             # progress for stall_ticks steps flips health to STALLED
             self.watchdog.observe_commits(
-                rt.spec.type_code, rt.kv.stats["own_commits"],
-                sum(len(e[1]["tag"]) if e[0] == "chunk" else 1
-                    for q in rt.pending for e in q))
+                rt.spec.type_code if self._shard_id is None
+                else f"{rt.spec.type_code}@s{self._shard_id}",
+                rt.kv.stats["own_commits"],
+                sum(_entry_ops(e) for q in rt.pending for e in q))
         self.ticks += 1
+        self._last_round_t = time.perf_counter()
 
         # answer reads post-tick, once (a) the key's create has committed
         # in the home view and (b) every earlier update from the same
@@ -630,6 +962,8 @@ class JanusService:
         self._step_ms.append(1e3 * (time.perf_counter() - t_step))
         if len(self._step_ms) > 10_000:
             del self._step_ms[:5_000]
+        if self._shard_m is not None:
+            self._last_step_end = time.perf_counter()
         return busy
 
     def _ingest(self, it: dict, reads: List[dict], pos: int = 0) -> None:
@@ -723,7 +1057,7 @@ class JanusService:
     def _conn_has_pending(self, conn_id: int) -> bool:
         return self._conn_pending.get(conn_id, 0) > 0
 
-    def _ingest_columnar(self, polled) -> np.ndarray:
+    def _ingest_columnar(self, polled, reads: List[dict]) -> np.ndarray:
         """Vectorized routing for the hot op class: single-letter UPDATE
         ops of pnc/orset/lww/tpset/mvr whose key slot is already
         resolved for the client's home node and whose params are plain
@@ -767,6 +1101,32 @@ class JanusService:
             rs = np.where(
                 s_ok,
                 rt.fast_slot[home[idxs], np.clip(sr, 0, cap - 1)], -1)
+            if ((rs < 0) & s_ok).any():
+                # self-prime the slot table: fast_slot starts empty and
+                # was registered only when a slow-path op for that
+                # (home, key) reached _ingest — so a burst landing in
+                # one big drain BEFORE its combos were registered sent
+                # every op down the per-item path (one boarding lane
+                # each), collapsing goodput. Resolve the distinct
+                # missing combos here (same known_keys + committed-slot
+                # rules as _ingest); still-unresolved ops fall through
+                # to the residual path as before.
+                mi = np.nonzero((rs < 0) & s_ok)[0]
+                combos = {(int(h), int(r)) for h, r in
+                          zip(home[idxs[mi]], sr[mi])}
+                hit = False
+                for h, raw in combos:
+                    key = self._key_str(rt, t, raw)
+                    if key in rt.known_keys:
+                        slot = rt.rks.slot(h, key)
+                        if slot is not None:
+                            rt.fast_slot[h, raw] = slot
+                            hit = True
+                if hit:
+                    rs = np.where(
+                        s_ok,
+                        rt.fast_slot[home[idxs], np.clip(sr, 0, cap - 1)],
+                        -1)
             kind = self._fast_kind[t]
             if kind == "pnc":
                 # i/d amount; default 1 when the client sent no params
@@ -786,7 +1146,7 @@ class JanusService:
             rslot[sel] = rs[ok]
             boundary[idxs[(oid >= 0) & (rs >= 0) & ~p_ok]] = True
         if not fast.any():
-            return np.arange(m_total)
+            return self._ingest_residual(polled, fast, reads)
 
         import janus_tpu.models.orset as orset_mod
         for t in self._fast_ops:
@@ -829,12 +1189,17 @@ class JanusService:
                         a2 = (ts & 0x7FFFFFFF).astype(np.int32)
                     else:  # tpset / mvr
                         a0 = p0[run].astype(np.int32)
+                    chunk = {
+                        "op": o, "key": rslot[run], "a0": a0,
+                        "a1": a1, "a2": a2, "safe": safe_f[run],
+                        "tag": tags[run],
+                    }
+                    if kind == "pnc":
+                        chunk = self._combine_pnc_chunk(
+                            chunk, min(self.cfg.block_floor,
+                                       self.cfg.ops_per_block))
                     self._stage.setdefault((t, int(v)), []).append(
-                        (int(run[0]), ("chunk", {
-                            "op": o, "key": rslot[run], "a0": a0,
-                            "a1": a1, "a2": a2, "safe": safe_f[run],
-                            "tag": tags[run],
-                        })))
+                        (int(run[0]), ("chunk", chunk)))
         # bookkeeping in batch: read-your-writes pending counts per
         # connection, immediate success replies for unsafe updates
         uconn, ucnt = np.unique(conn[fast], return_counts=True)
@@ -842,9 +1207,104 @@ class JanusService:
             self._conn_pending[c] = self._conn_pending.get(c, 0) + k
         unsafe = fast & ~safe_f
         if unsafe.any():
-            self._reply_buf.extend(
-                (t, "success", "ok") for t in tags[unsafe].tolist())
-        return np.nonzero(~fast)[0]
+            # immediate unsafe acks ride the native bulk reply: the
+            # shared "success" frame renders ONCE in C and fans out per
+            # connection, vs a Python tuple + frame encode per op.
+            # .copy() is load-bearing — poll buffers are reused.
+            self._ack_bulk.append(tags[unsafe].copy())
+        return self._ingest_residual(polled, fast, reads)
+
+    def _combine_pnc_chunk(self, cols: Dict[str, np.ndarray],
+                           limit: int) -> dict:
+        """Host-side delta combiner for counter updates. Within one
+        columnar run, UNSAFE pnc ops collapse per (op, key) into a
+        single device lane carrying the summed amount: increments
+        commute and have no per-op device identity (their acks already
+        went out at ingest), so the consensus block applies the exact
+        same delta in a fraction of the lanes — this is what moves the
+        wire plane past the ~230k ops/s linear-in-B megatick ceiling.
+        Safe ops keep their lanes (deferred acks map per lane).
+
+        A combined chunk additionally carries:
+          "pend" — (conns, counts) of every ORIGINAL op, consumed by
+                   _step_type at block-accept so the read-your-writes
+                   barrier still counts wire ops, not lanes;
+          "nops" — original op count, for backlog gauges.
+        Such chunks board atomically (never sliced): their aggregate
+        bookkeeping cannot be split mid-chunk. ``limit`` is the
+        guaranteed minimum block capacity (the adaptive controller's
+        floor) — runs whose combined form would exceed it stay
+        uncombined so an atomic chunk can always board an empty block."""
+        safe = cols["safe"]
+        n_unsafe = len(safe) - int(safe.sum())
+        if n_unsafe <= 1:
+            return cols
+        out = _combine_lanes(cols, limit)
+        if out is None or len(out["tag"]) >= len(safe):
+            return cols  # no win, or atomic chunk might never fit
+        conns = (cols["tag"] >> np.uint64(32)).astype(np.int64)
+        out["pend"] = np.unique(conns, return_counts=True)
+        out["nops"] = len(safe)
+        return out
+
+    def _ingest_residual(self, polled, fast: np.ndarray,
+                         reads: List[dict]) -> np.ndarray:
+        """Batched decode for the two residual op classes the columnar
+        update lane skips but that still dominate mixed workloads:
+        reads (gp/gs/sp/ss) and repeat creates of already-materialized
+        keys. Both used to take the full per-item _ingest walk — a
+        dict build plus branch ladder per op — re-paying exactly the
+        dispatch cost the columnar lane exists to delete. Here each
+        poll decodes them in one pass per type; whatever remains
+        (first-time creates, control ops, rga, unknown keys/types)
+        keeps the per-item path and is returned as slow indices."""
+        rest = ~fast
+        if not rest.any():
+            return np.nonzero(rest)[0]
+        tid_arr = polled["type_id"]
+        opc = polled["op_code"]
+        tags = polled["client_tag"]
+        slot_raw = polled["key_slot"]
+        known_slot = rest & (slot_raw >= 0)
+        read_m = known_slot & np.isin(opc, self._read_opcs)
+        create_m = known_slot & (opc == np.int32(ord("s")))
+        if not (read_m.any() or create_m.any()):
+            return np.nonzero(rest)[0]
+        handled = np.zeros(len(tags), bool)
+        conn = (tags >> np.uint64(32)).astype(np.int64)
+        home = self._homes_np[conn % len(self._homes)]
+        p0, p1, npar = polled["p0"], polled["p1"], polled["n_params"]
+        for t in self._tid_order:
+            rt = self.types.get(t)
+            if rt is None:
+                continue
+            tm = tid_arr == t
+            for i in np.nonzero(read_m & tm)[0].tolist():
+                key = self._key_str(rt, t, int(slot_raw[i]))
+                tag = int(tags[i])
+                if key not in rt.known_keys:
+                    self._reply(tag, "error: no such key", "err")
+                else:
+                    reads.append({
+                        "tag": tag, "tid": t,
+                        "letters": self._read_letters[int(opc[i])],
+                        "key": key, "p0": int(p0[i]), "p1": int(p1[i]),
+                        "n_params": int(npar[i]),
+                    })
+                handled[i] = True
+            c_idx = np.nonzero(create_m & tm)[0]
+            if c_idx.size:
+                done = []
+                for i in c_idx.tolist():
+                    key = self._key_str(rt, t, int(slot_raw[i]))
+                    if rt.rks.slot(int(home[i]), key) is not None:
+                        # create of an already-materialized key: the
+                        # per-item path would ack "success" immediately
+                        done.append(int(tags[i]))
+                        handled[i] = True
+                if done:
+                    self._ack_bulk.append(np.asarray(done, np.uint64))
+        return np.nonzero(rest & ~handled)[0]
 
     def _op_fields(self, rt: _TypeRuntime, op_id: int, slot: int, home: int,
                    it: dict) -> Optional[Dict[str, int]]:
@@ -976,6 +1436,23 @@ class JanusService:
             if rt.node is not None:
                 rt.node.step(record=False)
                 return False
+            # Idle keep-alive rounds exist to finish commits, and a
+            # device round costs the same ~ms whether loaded or empty —
+            # on a saturated one-core host they were the single largest
+            # CPU consumer, starving the very ingest that would have
+            # made the next step a payload step. So gate them on actual
+            # need: when nothing awaits a commit (no deferred safe
+            # acks, no unmaterialized creates), a fresh lull first
+            # yields the core (new ops usually arrive within ms), and
+            # once a full trailing window of rounds has settled every
+            # boarded block into stable state the type quiesces
+            # entirely. New payload resets both clocks.
+            if not rt.ack_map and not rt.create_tags:
+                if time.perf_counter() - rt.last_payload_t < 0.01:
+                    return False  # fresh lull: yield instead of burn
+                if rt.idle_rounds >= 4 * rt.kv.cfg.num_rounds + 8:
+                    return False  # quiesced until new ops arrive
+            rt.idle_rounds += 1
             import jax
             if rt.idle_batch is None or rt.idle_batch["op"].shape[1] != B:
                 rt.idle_batch = jax.device_put(base.make_op_batch(
@@ -984,6 +1461,8 @@ class JanusService:
             rt.kv.step(rt.idle_batch, record=False)
             self._sched_update(rt, time.perf_counter() - t0)
             return False
+        rt.idle_rounds = 0
+        rt.last_payload_t = time.perf_counter()
         batch = {f: np.zeros((n, B), np.int32) for f in base.OP_FIELDS}
         safe = np.zeros((n, B), bool)
         placed: List[List[Tuple[int, bool, int, Optional[int]]]] = [
@@ -1004,6 +1483,14 @@ class JanusService:
                     cols = entry[1]
                     cnt = len(cols["tag"])
                     take = min(B - b, cnt)
+                    if take < cnt and "pend" in cols:
+                        # combined chunks board atomically — their
+                        # aggregate conn accounting cannot split. Lane
+                        # count is bounded by distinct (op, key) pairs,
+                        # far under any block size, so this only defers
+                        # when the block is nearly full already.
+                        rt.pending[v].appendleft(entry)
+                        break
                     if take < cnt:
                         head = {f: a[:take] for f, a in cols.items()}
                         rt.pending[v].appendleft(
@@ -1092,8 +1579,13 @@ class JanusService:
                     if is_safe:
                         rt.ack_map[(int(slots[v]), v, b)] = tag
                 for b0, head in fast_placed[v]:
-                    conns = (head["tag"] >> np.uint64(32)).astype(np.int64)
-                    uconn, ucnt = np.unique(conns, return_counts=True)
+                    pend = head.get("pend")
+                    if pend is not None:
+                        uconn, ucnt = pend
+                    else:
+                        conns = (head["tag"] >>
+                                 np.uint64(32)).astype(np.int64)
+                        uconn, ucnt = np.unique(conns, return_counts=True)
                     for c, k in zip(uconn.tolist(), ucnt.tolist()):
                         left = self._conn_pending.get(c, 0) - k
                         if left <= 0:
@@ -1119,8 +1611,7 @@ class JanusService:
         if rt.sched is None:
             return
         backlog = max(
-            (sum(len(e[1]["tag"]) if e[0] == "chunk" else 1 for e in q)
-             for q in rt.pending),
+            (sum(_entry_ops(e) for e in q) for q in rt.pending),
             default=0)
         rt.sched.observe(backlog, seal_sec * 1e3)
         target = rt.sched.maybe_adjust()
@@ -1200,14 +1691,149 @@ class JanusService:
             return "".join(chr(int(c)) for c in chars)
         return "error: unreadable type"
 
-    def _stats_report(self) -> str:
+    # -- shard routing (front-end only) ----------------------------------
+
+    def _route_step(self) -> bool:
+        """One front-end round: poll the wire once, answer control ops
+        in place, hand every data op to its owning shard's inbox as a
+        column chunk. No device work happens on this thread — the poll
+        cap scales with the shard count so one poll can feed every
+        worker a full block."""
+        cfg = self.cfg
+        nw = len(self.workers)
+        polled = self.server.poll_batch(
+            min(65536 * nw,
+                max(_POLL_FLOOR,
+                    cfg.num_nodes * cfg.ops_per_block * nw)))
+        count = len(polled["client_tag"])
+        if not count:
+            return False
+        self.perf.add(count)
+        tid_arr = polled["type_id"]
+        ctrl = np.isin(tid_arr, self._ctrl_tids)
+        shard = self._route_shards(polled, ~ctrl)
+        for k, w in enumerate(self.workers):
+            m = shard == k
+            if m.any():
+                # fancy-index COPIES — inbox chunks must not alias the
+                # native poll buffers, which the next poll overwrites
+                w._inbox.put({f: v[m] for f, v in polled.items()})
+        for i in np.nonzero(ctrl)[0].tolist():
+            self._ctrl_reply(int(tid_arr[i]),
+                             int(polled["client_tag"][i]))
+        self.ticks += 1
+        return True
+
+    def _route_shards(self, polled, data_mask: np.ndarray) -> np.ndarray:
+        """Owning shard per op via shard_of(type_code, key_name). The
+        (tid, native slot) -> shard map is a flat LUT resolved on first
+        sight of each slot; after warmup routing is one gather per
+        type. Control ops keep shard -1."""
+        tid_arr = polled["type_id"]
+        slot_arr = polled["key_slot"]
+        out = np.full(len(tid_arr), -1, np.int32)
+        ns = self.cfg.shards
+        for tid, lut in self._shard_lut.items():
+            m = np.nonzero(data_mask & (tid_arr == tid))[0]
+            if not m.size:
+                continue
+            sl = slot_arr[m]
+            ok = (sl >= 0) & (sl < len(lut))
+            m, sl = m[ok], sl[ok]
+            if not m.size:
+                continue
+            sh = lut[sl]
+            if (sh < 0).any():
+                tc = self._tid_code[tid]
+                for s in np.unique(sl[sh < 0]).tolist():
+                    name = self.server.key_name(tid, int(s)) or f"?{s}"
+                    lut[s] = shard_of(tc, name, ns)
+                sh = lut[sl]
+            out[m] = sh
+        # data ops of types outside the LUT (none today) fall back to
+        # shard 0 rather than vanishing
+        claimed = out >= 0
+        out[data_mask & ~claimed] = 0
+        return out
+
+    def _ctrl_reply(self, tid: int, tag: int) -> None:
+        if tid == self._stats_tid:
+            self._reply(tag, json.dumps(self._stats_merged()), "ok")
+        elif tid == self._metrics_tid:
+            self._reply(tag, self._metrics_report(), "ok")
+        elif tid == self._health_tid:
+            self._reply(tag, json.dumps(self._health_merged()), "ok")
+        elif tid == self._trace_tid:
+            self._reply(tag,
+                        chrome_trace_json(self._flight.snapshot()), "ok")
+
+    def _stats_merged(self) -> dict:
+        """Cluster-wide stats: wire counters from the shared server,
+        per-type stats merged across shards (counters sum, structural
+        keys min/max — _merge_type_stats), per-shard breakdown under
+        "shards". Worker state is read from this thread without
+        synchronization: GIL-consistent, telemetry-grade."""
+        dt = max(time.monotonic() - self._t0, 1e-9)
+        ops = self.server.ops_received()
+        per_shard: Dict[str, dict] = {}
+        type_snaps: Dict[str, List[dict]] = {}
+        step_ms: List[float] = []
+        for k, w in enumerate(self.workers):
+            d = w._stats_dict(include_registry=False)
+            for tc, snap in d["types"].items():
+                type_snaps.setdefault(tc, []).append(snap)
+            step_ms.extend(w._step_ms[-2048:])
+            per_shard[str(k)] = d
+        steps = np.asarray(step_ms) if step_ms else np.zeros(1)
+        return {
+            "ops_received": ops,
+            "replies_sent": self.server.replies_sent(),
+            "ticks": self.ticks,  # router rounds; worker ticks per shard
+            "uptime_sec": round(dt, 3),
+            "ops_per_sec": round(ops / dt, 1),
+            "perf": self.perf.report(),
+            "step_ms_p50": round(float(np.percentile(steps, 50)), 2),
+            "step_ms_p99": round(float(np.percentile(steps, 99)), 2),
+            "shard_count": self.cfg.shards,
+            "inbox_depth": sum(w._inbox.depth for w in self.workers),
+            "types": {tc: _merge_type_stats(snaps)
+                      for tc, snaps in type_snaps.items()},
+            "health": self._health_merged(),
+            "metrics": obs_metrics.get_registry().snapshot(),
+            "shards": per_shard,
+        }
+
+    def _health_merged(self) -> dict:
+        """Worst-of across shard watchdogs; reasons and equivocation
+        sources carry an s{K} prefix so the culprit shard is evident."""
+        merged: Dict[str, Any] = {"status": "OK", "reasons": [],
+                                  "anomalies": 0, "dumps": 0,
+                                  "equivocation": {}}
+        order = {"OK": 0, "DEGRADED": 1, "STALLED": 2}
+        for k, w in enumerate(self.workers):
+            h = w.watchdog.health()
+            if order.get(h["status"], 1) > order.get(merged["status"], 0):
+                merged["status"] = h["status"]
+            merged["reasons"].extend(
+                f"s{k}: {r}" for r in h.get("reasons", []))
+            merged["anomalies"] += int(h.get("anomalies", 0))
+            merged["dumps"] += int(h.get("dumps", 0))
+            for src, cnt in (h.get("equivocation") or {}).items():
+                merged["equivocation"][f"s{k}:{src}"] = cnt
+        return merged
+
+    # -- in-band telemetry ------------------------------------------------
+
+    def _stats_dict(self, include_registry: bool = True) -> dict:
         """In-band observability (PerfCounter.cs:13-88 + DAGStats.cs:5-66
         + StatsCommand.cs:14-21): wire counters, ops/s windows, step
-        timing, and per-type consensus-runtime counters."""
+        timing, and per-type consensus-runtime counters. Wire counters
+        (ops_received/replies_sent) are server-global — on a shard
+        worker they count the whole cluster's traffic."""
         dt = max(time.monotonic() - self._t0, 1e-9)
         ops = self.server.ops_received()
         steps = np.asarray(self._step_ms) if self._step_ms else np.zeros(1)
-        return json.dumps({
+        out = {
             "ops_received": ops,
             "replies_sent": self.server.replies_sent(),
             "ticks": self.ticks,
@@ -1222,31 +1848,54 @@ class JanusService:
                 }
                 for rt in self.types.values()
             },
+            # ops routed to this worker but not yet drained from its
+            # inbox (always 0 off the shard path): completion checks
+            # need it — pending_ops only sees ops past ingest
+            "inbox_depth": (self._inbox.depth
+                            if self._inbox is not None else 0),
             # watchdog verdict (OK / DEGRADED / STALLED + reasons; the
             # standalone `health` command answers with just this)
             "health": self.watchdog.health(),
+        }
+        if include_registry:
             # full telemetry-plane snapshot (JSON exposition; the
             # Prometheus text form lives on the `metrics` command)
-            "metrics": obs_metrics.get_registry().snapshot(),
-        })
+            out["metrics"] = obs_metrics.get_registry().snapshot()
+        return out
 
-    def _metrics_report(self) -> str:
-        """Prometheus text exposition. Scrape-time-only work happens
-        here: consensus-state gauges (small device fetches) and live
-        queue depths refresh, then the registry renders."""
+    def _stats_report(self) -> str:
+        return json.dumps(self._stats_dict())
+
+    def _refresh_scrape_gauges(self) -> None:
+        """Scrape-time-only gauge refresh: consensus-state observers
+        (small device fetches) and live queue depths. Shard workers
+        suffix every name with _s{K}; shards=1 keeps the bare names."""
         reg = obs_metrics.get_registry()
+        sfx = (f"_s{self._shard_id}" if self._shard_id is not None
+               and self.cfg.shards > 1 else "")
         for rt in self.types.values():
             tc = rt.spec.type_code
-            dagmod.observe_dag(rt.kv.cfg, rt.kv.dag, reg, scope=f"dag_{tc}")
+            dagmod.observe_dag(rt.kv.cfg, rt.kv.dag, reg,
+                               scope=f"dag_{tc}{sfx}")
             tusk.observe_commit(rt.kv.cfg, rt.kv.commit, reg,
-                                scope=f"tusk_{tc}")
-            reg.gauge(f"svc_{tc}_block_size").set(rt.kv.B)
-            reg.gauge(f"svc_{tc}_pending_ops").set(sum(
-                len(e[1]["tag"]) if e[0] == "chunk" else 1
-                for q in rt.pending for e in q))
+                                scope=f"tusk_{tc}{sfx}")
+            reg.gauge(f"svc_{tc}{sfx}_block_size").set(rt.kv.B)
+            reg.gauge(f"svc_{tc}{sfx}_pending_ops").set(
+                _pending_total(rt.pending))
+
+    def _metrics_report(self) -> str:
+        """Prometheus text exposition. The front-end refreshes every
+        worker's gauges, then the shared registry renders once."""
+        reg = obs_metrics.get_registry()
+        if self._front:
+            for w in self.workers:
+                w._refresh_scrape_gauges()
+                w.watchdog.health()  # refresh the watchdog_health gauge
+        else:
+            self._refresh_scrape_gauges()
+            self.watchdog.health()
         reg.gauge("svc_ticks").set(self.ticks)
         reg.gauge("svc_ops_received").set(self.server.ops_received())
-        self.watchdog.health()  # refresh the watchdog_health gauge
         return render_prometheus(reg)
 
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import re
 import threading
+from typing import Optional
 
 # characters legal in a metric name; substitute the rest with "_"
 _NAME_SAFE = re.compile(r"[^a-zA-Z0-9_:]")
@@ -255,3 +256,25 @@ _REGISTRY = Registry()
 def get_registry() -> Registry:
     """The process-wide default registry."""
     return _REGISTRY
+
+
+def shard_instruments(shard: int, registry: Optional[Registry] = None) -> dict:
+    """Per-shard service-plane instruments (the registry has no label
+    support, so the shard index lands in the metric name — same
+    convention as the per-type ``svc_{tc}_*`` gauges):
+
+    - ``shard{K}_ops_total``   counter: ops ingested by worker K
+    - ``shard{K}_queue_depth`` gauge: ops waiting in worker K's inbox
+      at the last step start (routing outpacing the worker -> growth)
+    - ``shard{K}_step_lag_ms`` gauge: gap between worker K's successive
+      steps (scheduling starvation shows up here before queue depth)
+
+    ``render_prometheus`` emits ``# HELP``/``# TYPE`` lines for these
+    like any other instrument.
+    """
+    reg = registry if registry is not None else get_registry()
+    return {
+        "ops_total": reg.counter(f"shard{shard}_ops_total"),
+        "queue_depth": reg.gauge(f"shard{shard}_queue_depth"),
+        "step_lag": reg.gauge(f"shard{shard}_step_lag_ms"),
+    }

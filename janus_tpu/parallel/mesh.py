@@ -68,6 +68,26 @@ def sharded_tick(spec: base.CRDTTypeSpec, mesh: Mesh, state: Any, ops: base.OpBa
     )
 
 
+def pin_kv_to_device(kv: Any, device) -> Any:
+    """Pin one emulated cluster's device state to ONE mesh member — the
+    service-plane shard layout (JanusConfig.shard_devices): shard K's
+    whole SafeKV lives on ``jax.devices()[K % ndev]``, so the per-shard
+    jitted step programs execute on distinct devices and overlap, while
+    each program's collectives stay device-local (the cluster is
+    emulated inside one shard, not split across the mesh — that is what
+    make_mesh/state_sharding are for).
+
+    Moves every attribute whose pytree leaves are all jax Arrays
+    (prospective/stable/dag/commit/ops_buffer/..., robust to SafeKV
+    growing new device attrs); host-side numpy state and Python
+    bookkeeping stay put."""
+    for name, val in list(vars(kv).items()):
+        leaves = jax.tree.leaves(val)
+        if leaves and all(isinstance(x, jax.Array) for x in leaves):
+            setattr(kv, name, jax.device_put(val, device))
+    return kv
+
+
 def dirty_sharding(mesh: Mesh):
     """Dirty masks [R, K] shard like state rows: (replica, key)."""
     return NamedSharding(mesh, P("replica", "key"))

@@ -31,6 +31,33 @@ from typing import Dict, List, Optional, Tuple
 
 from janus_tpu.utils.ids import Interner
 
+# FNV-1a 64-bit: stable across processes and Python versions (unlike
+# hash(), which PYTHONHASHSEED randomizes), cheap enough that the
+# service only ever pays it once per (type, key) — routing lookups hit
+# a per-type slot->shard LUT after first resolution
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def shard_of(type_code: str, key: str, num_shards: int) -> int:
+    """Stable shard assignment for a (type, key) pair.
+
+    Membership-independent for a FIXED shard count: the hash depends
+    only on the type code and key name, so every process (and every
+    restart) routes a key to the same shard — the property the sharded
+    service plane needs so a client may reconnect anywhere and still
+    find its keys. Changing ``num_shards`` remaps keys (plain mod, not
+    consistent hashing): shard count is a boot-time constant here, the
+    same way the emulated node count is.
+    """
+    if num_shards <= 1:
+        return 0
+    h = _FNV_OFFSET
+    for b in f"{type_code}/{key}".encode():
+        h = ((h ^ b) * _FNV_PRIME) & _MASK64
+    return h % num_shards
+
 
 @dataclasses.dataclass
 class TypedKeySpace:

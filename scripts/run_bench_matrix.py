@@ -61,6 +61,13 @@ def _smoke_cfg(name, cfg):
     elif cfg.mode == "wire_native":
         over = dict(num_objects=32, ops_per_block=256, clients=2,
                     ops_per_client=3000, pipeline=64)
+    elif cfg.mode == "wire_sharded":
+        # both A/B arms run the same shrunken schedule; the run's own
+        # bit-equality gate (sharded vs unsharded final state) is the
+        # assertion under test, so the smoke only needs enough ops to
+        # cross a few drain/combine/board cycles per shard
+        over = dict(num_objects=16, ops_per_block=64, clients=2,
+                    ops_per_client=4096, frame_ops=512, shards=2)
     elif name == "mixed":
         over = dict(num_nodes=4, num_objects=64, ops_per_block=32,
                     ticks=2)

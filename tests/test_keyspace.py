@@ -74,3 +74,41 @@ def test_plain_keyspace_resolve():
     assert not existed and s0 == 0
     s1, existed = ks.resolve("pnc", "a")
     assert existed and s1 == 0
+
+
+# -- shard_of: the sharded service plane's routing hash -----------------
+
+def test_shard_of_stable_across_restarts():
+    """shard_of is FNV-1a over "{type}/{key}" — no process state, no
+    PYTHONHASHSEED. These values are pinned: a drift here silently
+    re-homes every key after a restart (a reconnecting client would
+    stop finding its data)."""
+    from janus_tpu.runtime.keyspace import shard_of
+
+    assert [shard_of("pnc", f"o{k}", 2) for k in range(8)] == \
+        [0, 1, 0, 1, 0, 1, 0, 1]
+    assert [shard_of("pnc", f"o{k}", 4) for k in range(8)] == \
+        [2, 1, 0, 3, 2, 1, 0, 3]
+    assert shard_of("orset", "o0", 4) == 2      # type code is hashed too
+    assert shard_of("pnc", "user:42", 7) == 3
+
+
+def test_shard_of_uniform_over_keyspace():
+    """Over 10k distinct keys every shard holds its fair share +/- 20%
+    — the load-balance property the per-shard megatick relies on."""
+    from janus_tpu.runtime.keyspace import shard_of
+
+    for ns in (2, 4, 8):
+        counts = [0] * ns
+        for k in range(10_000):
+            counts[shard_of("pnc", f"key-{k}", ns)] += 1
+        fair = 10_000 / ns
+        for c in counts:
+            assert 0.8 * fair <= c <= 1.2 * fair, (ns, counts)
+
+
+def test_shard_of_degenerate_single_shard():
+    from janus_tpu.runtime.keyspace import shard_of
+
+    assert all(shard_of("pnc", f"k{i}", 1) == 0 for i in range(64))
+    assert shard_of("pnc", "k", 0) == 0  # guard, not a divide

@@ -223,3 +223,45 @@ def test_metrics_command_round_trip(service):
         # the JSON side rides the existing stats command
         st = c.stats(timeout=60)
         assert st["metrics"]["stage_pnc_commit_ns"]["count"] >= 1
+
+
+# -- per-shard service-plane instruments --------------------------------
+
+def test_shard_instruments_create_and_record():
+    from janus_tpu.obs.metrics import shard_instruments
+
+    reg = Registry()
+    ins = {k: shard_instruments(k, reg) for k in range(2)}
+    ins[0]["ops_total"].add(4096)
+    ins[0]["queue_depth"].set(17)
+    ins[1]["step_lag"].set(2.5)
+    snap = reg.snapshot()
+    assert snap["shard0_ops_total"] == {"type": "counter", "value": 4096}
+    assert snap["shard0_queue_depth"]["value"] == 17
+    assert snap["shard1_step_lag_ms"]["value"] == 2.5
+    # idempotent: asking again hands back the SAME instruments (the
+    # worker re-resolves on restart without double-registering)
+    again = shard_instruments(0, reg)
+    assert again["ops_total"] is ins[0]["ops_total"]
+
+
+def test_shard_instruments_render_with_help_lines():
+    from janus_tpu.obs.metrics import shard_instruments
+
+    reg = Registry()
+    ins = shard_instruments(3, reg)
+    ins["ops_total"].add(7)
+    ins["queue_depth"].set(1)
+    ins["step_lag"].set(0.25)
+    text = render_prometheus(reg)
+    lines = text.splitlines()
+    for name in ("shard3_ops_total", "shard3_queue_depth",
+                 "shard3_step_lag_ms"):
+        hi = next(i for i, ln in enumerate(lines)
+                  if ln.startswith(f"# HELP {name} "))
+        ti = next(i for i, ln in enumerate(lines)
+                  if ln.startswith(f"# TYPE {name} "))
+        assert hi < ti
+    parsed = parse_prometheus(text)
+    assert parsed["shard3_ops_total"] == 7
+    assert parsed["shard3_queue_depth"] == 1

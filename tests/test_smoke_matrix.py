@@ -49,6 +49,14 @@ def test_smoke_matrix_all_presets(tmp_path):
     assert "p90_ms" in adaptive["stages"]["commit"]
     # the clean delta run must come out healthy
     assert by_run["smoke_mixed_delta"]["health"]["status"] == "OK"
+    # sharded service plane: the A/B arms replayed the same schedule and
+    # read back bit-equal final state (the run itself asserts equality
+    # against the schedule's predicted sums before emitting the row)
+    ws = by_run["smoke_wire_sharded"]
+    assert ws["states_bitequal"] is True
+    assert ws["arm_sharded"]["shards"] >= 2
+    assert ws["arm_unsharded"]["goodput_ops_per_sec"] > 0
+    assert ws["arm_sharded"]["goodput_ops_per_sec"] > 0
     # flight recorder: tracing was live (events flowed) and cheap
     fl = by_run["smoke_flight_overhead"]["smoke"]
     assert fl["flight_events"] > 0
