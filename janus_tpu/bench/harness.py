@@ -912,6 +912,131 @@ def run_wire_native(cfg: BenchConfig) -> Results:
     return res
 
 
+class _ObsScraper(threading.Thread):
+    """Background out-of-band scraper running CONCURRENTLY with a loaded
+    arm: hits /metrics and /slo every ``period`` seconds, recording wall
+    latency per scrape and its own thread CPU. The CPU number (plus the
+    endpoint handler's self-accounted ``obs_http_cpu_ns``) is what
+    bounds the obs plane's goodput perturbation analytically — an A/B
+    wall-clock comparison at these run lengths is noise."""
+
+    def __init__(self, base_url: str, period: float = 0.5):
+        super().__init__(name="obs-scraper", daemon=True)
+        self.base = base_url.rstrip("/")
+        self.period = period
+        self.wall_ms: List[float] = []
+        self.errors = 0
+        self.cpu_ns = 0
+        # NOT named _stop: threading.Thread has a private _stop() method
+        # that join()/is_alive() call internally — shadowing it with an
+        # Event makes every join() raise
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        from janus_tpu.obs.httpexp import scrape_text
+        cpu0 = time.thread_time_ns()
+        while not self._halt.is_set():
+            for path in ("/metrics", "/slo"):
+                t0 = time.perf_counter()
+                try:
+                    scrape_text(self.base + path, timeout=5.0)
+                except Exception:
+                    self.errors += 1
+                self.wall_ms.append(1e3 * (time.perf_counter() - t0))
+            self._halt.wait(self.period)
+        self.cpu_ns = time.thread_time_ns() - cpu0
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=10)
+
+
+def slo_report(slo0: dict, slo1: dict, goodput_ops_per_sec: float,
+               total_ops: int) -> dict:
+    """Fold two /slo snapshots (before/after a timed run) into the
+    per-class SLO table: e2e p50/p99 recomputed from BUCKET-COUNT
+    deltas (so pre-run creates and warmup never dilute the window),
+    offered/admitted/replied counter deltas, and the replied-vs-offered
+    reconciliation against the harness's own op count."""
+    from janus_tpu.obs.metrics import percentile_from_counts
+    from janus_tpu.obs.slo import OP_CLASSES
+
+    rep: Dict[str, object] = {
+        "goodput_ops_per_sec": round(goodput_ops_per_sec, 1)}
+    replied_total = 0
+    for c in OP_CLASSES:
+        c0 = (slo0.get("classes") or {}).get(c) or {}
+        c1 = (slo1.get("classes") or {}).get(c) or {}
+        v0 = c0.get("counts") or []
+        v1 = c1.get("counts") or []
+        dc = [int(b) - int(a) for a, b in
+              zip(v0 + [0] * (len(v1) - len(v0)), v1)]
+        replied = int(c1.get("replied", 0)) - int(c0.get("replied", 0))
+        replied_total += replied
+        rep[c] = {
+            "replied": replied,
+            "e2e_samples": (int(c1.get("e2e_samples", 0))
+                            - int(c0.get("e2e_samples", 0))),
+            "e2e_p50_ms": round(
+                percentile_from_counts(dc, 0.50) / 1e6, 3),
+            "e2e_p99_ms": round(
+                percentile_from_counts(dc, 0.99) / 1e6, 3),
+        }
+    for k in ("offered", "admitted", "shed"):
+        rep[k] = int(slo1.get(k, 0)) - int(slo0.get(k, 0))
+    rep["replied_total"] = replied_total
+    # replies per scheduled fleet op: 1.0 when the ledger saw every op
+    # exactly once (in-band stats polls are control ops — never ledgered)
+    rep["replied_vs_total"] = round(replied_total / max(total_ops, 1), 4)
+    return rep
+
+
+def fold_slo_reports(path: str) -> List[dict]:
+    """Collect the SLO report rows from a results_*.jsonl file: one
+    entry per run that recorded ``slo_report`` (wire_sharded arms),
+    keyed by config name, with the per-class table and goodput."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            sr = row.get("slo_report")
+            if not sr:
+                continue
+            out.append({"config": row.get("config", "?"),
+                        "run": row.get("run", row.get("mode", "?")),
+                        "ts": row.get("ts"),
+                        "oob": row.get("oob"),
+                        "slo": sr})
+    return out
+
+
+def _print_slo_reports(rows: List[dict]) -> None:
+    from janus_tpu.obs.slo import OP_CLASSES
+    for r in rows:
+        sr = r["slo"]
+        print(f"== {r['config']} ({r['run']}) — SLO report ==")
+        print(f"goodput: {sr['goodput_ops_per_sec']:>12,.1f} ops/s   "
+              f"offered {sr['offered']:,}  admitted {sr['admitted']:,}  "
+              f"replied {sr['replied_total']:,} "
+              f"(x{sr['replied_vs_total']} of scheduled)")
+        for c in OP_CLASSES:
+            d = sr.get(c) or {}
+            if not d.get("replied"):
+                continue
+            print(f"  {c:>8}: n={d['replied']:<9,} "
+                  f"p50 {d['e2e_p50_ms']:>9.3f} ms   "
+                  f"p99 {d['e2e_p99_ms']:>9.3f} ms")
+        oob = r.get("oob")
+        if oob:
+            print(f"  oob scrape: /health {oob['health_ms']:.1f} ms, "
+                  f"/slo {oob['slo_ms']:.1f} ms under load; "
+                  f"{oob['scrapes']} concurrent scrapes, "
+                  f"cpu_frac {oob['cpu_frac']:.4f}")
+
+
 def _wire_sharded_arm(cfg: BenchConfig, shards: int,
                       schedule: Dict[str, object]) -> Dict[str, object]:
     """One A/B arm of the sharded-wire benchmark: start a service with
@@ -926,13 +1051,17 @@ def _wire_sharded_arm(cfg: BenchConfig, shards: int,
 
     n_keys = int(schedule["n_keys"])
     keys = [f"o{k}" for k in range(n_keys)]
+    from janus_tpu.obs.httpexp import scrape_json
+
     svc = JanusService(JanusConfig(
         num_nodes=cfg.num_nodes, window=cfg.window,
         ops_per_block=cfg.ops_per_block, max_clients=cfg.clients + 8,
-        shards=shards, ingest_batch=cfg.ingest_batch,
+        shards=shards, ingest_batch=cfg.ingest_batch, obs_port=0,
         types=(TypeConfig("pnc", {"num_keys": n_keys}),)))
     port = svc.start()
+    obs_base = f"http://127.0.0.1:{svc.obs_port}"
     arm: Dict[str, object] = {"shards": shards}
+    scraper = None
     try:
         pre = JanusClient("127.0.0.1", port, timeout=120)
         for k in keys:
@@ -954,6 +1083,23 @@ def _wire_sharded_arm(cfg: BenchConfig, shards: int,
 
         stats0 = server_stats()
         ops0 = stats0["ops_received"] - polls[0]
+        # SLO baseline: wait for the warmup's deferred work to settle
+        # (replied_total stable across reads) so the timed window's
+        # counter deltas cover exactly the fleet's ops
+        slo0 = scrape_json(obs_base + "/slo")
+        settle_deadline = time.monotonic() + 30
+        while time.monotonic() < settle_deadline:
+            time.sleep(0.1)
+            again = scrape_json(obs_base + "/slo")
+            if again["replied_total"] == slo0["replied_total"]:
+                break
+            slo0 = again
+        from janus_tpu.obs import metrics as _obs_metrics
+        http_cpu = _obs_metrics.get_registry().counter("obs_http_cpu_ns")
+        http_cpu0 = http_cpu.value
+        # concurrent out-of-band scrape load for the whole timed run
+        scraper = _ObsScraper(obs_base, period=0.5)
+        scraper.start()
         # reply lag floor: 1 for the stats request answering this very
         # snapshot, plus any pre-run replies that died with a closed
         # connection (none expected, but the check must not hang on one)
@@ -979,6 +1125,15 @@ def _wire_sharded_arm(cfg: BenchConfig, shards: int,
         for t in threads:
             t.join()
         t_send = time.perf_counter()
+        # acceptance probe: at this moment the whole schedule is offered
+        # and the backlog is at its deepest — an out-of-band scrape must
+        # still answer promptly while in-band stats ops queue behind it
+        t_h = time.perf_counter()
+        scrape_json(obs_base + "/health")
+        health_ms = 1e3 * (time.perf_counter() - t_h)
+        t_s = time.perf_counter()
+        scrape_json(obs_base + "/slo")
+        slo_ms = 1e3 * (time.perf_counter() - t_s)
         # completion: every fleet op arrived, no op waiting in a shard
         # inbox or a pending queue, and replies have caught up with
         # ingest (reply lag 1 = only the current stats request itself
@@ -1000,11 +1155,33 @@ def _wire_sharded_arm(cfg: BenchConfig, shards: int,
                     f"{pending} pending, {inbox} inboxed, lag {lag}")
             time.sleep(0.025)
         t_done = time.perf_counter()
+        # handler-CPU window closes WITH the goodput window: the slo1
+        # scrape below happens after t_done, so its handler cost must
+        # not be charged against the run it didn't overlap
+        http_cpu1 = http_cpu.value
+        # post-run SLO snapshot BEFORE the read-back ops so the window's
+        # deltas cover exactly the fleet schedule
+        slo1 = scrape_json(obs_base + "/slo")
+        scraper.stop()
         for s in senders:
             s.close()
         arm["offered_ops_per_sec"] = round(total / (t_send - t0), 1)
         arm["goodput_ops_per_sec"] = round(total / (t_done - t0), 1)
         arm["elapsed_s"] = round(t_done - t0, 3)
+        arm["slo_report"] = slo_report(
+            slo0, slo1, arm["goodput_ops_per_sec"], total)
+        # obs-plane cost: endpoint handler CPU + scraper thread CPU over
+        # the run's wall time — the analytical goodput-perturbation bound
+        cpu_frac = ((http_cpu1 - http_cpu0) + scraper.cpu_ns) \
+            / max(1e9 * (t_done - t0), 1)
+        arm["oob"] = {
+            "health_ms": round(health_ms, 2),
+            "slo_ms": round(slo_ms, 2),
+            "scrapes": len(scraper.wall_ms),
+            "scrape_errors": scraper.errors,
+            "scrape_ms_max": round(max(scraper.wall_ms, default=0.0), 2),
+            "cpu_frac": round(cpu_frac, 5),
+        }
         # per-op dispatch cost from server-side step timing deltas (the
         # wire_native formula); sharded arms average worker ticks
         if "shards" in st:
@@ -1027,6 +1204,8 @@ def _wire_sharded_arm(cfg: BenchConfig, shards: int,
         arm["finals"] = finals
         pre.close()
     finally:
+        if scraper is not None and scraper.is_alive():
+            scraper.stop()
         svc.stop()
     return arm
 
@@ -1077,10 +1256,15 @@ def run_wire_sharded(cfg: BenchConfig) -> Results:
         f"  sharded:   {arm_b['finals'][:8]}...\n"
         f"  expected:  {expect_l[:8]}...")
     res.extra["states_bitequal"] = True
+    drop = {"finals", "slo_report", "oob"}
     res.extra["arm_unsharded"] = {k: v for k, v in arm_a.items()
-                                  if k != "finals"}
+                                  if k not in drop}
     res.extra["arm_sharded"] = {k: v for k, v in arm_b.items()
-                                if k != "finals"}
+                                if k not in drop}
+    # the sharded arm's SLO table + oob scrape probe are the run's
+    # headline observability row (fold_slo_reports picks these up)
+    res.extra["slo_report"] = arm_b.get("slo_report")
+    res.extra["oob"] = arm_b.get("oob")
     res.extra["shard_speedup"] = round(
         arm_b["goodput_ops_per_sec"]
         / max(arm_a["goodput_ops_per_sec"], 1e-9), 3)
@@ -1434,7 +1618,17 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="capture a jax.profiler device trace of the "
                          "run; correlate with --trace-out by wall clock "
                          "(flight spans carry absolute time.time_ns)")
+    ap.add_argument("--slo-report", metavar="PATH",
+                    help="print the per-class SLO tables recorded in a "
+                         "results_*.jsonl file and exit (no run)")
     args = ap.parse_args(argv)
+    if args.slo_report:
+        rows = fold_slo_reports(args.slo_report)
+        if not rows:
+            print(f"# no slo_report rows in {args.slo_report}")
+        else:
+            _print_slo_reports(rows)
+        return
     if args.config:
         cfg = BenchConfig.from_json(open(args.config).read())
     else:

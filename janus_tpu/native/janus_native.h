@@ -74,12 +74,16 @@ int janus_server_register_type(JanusServer* s, const char* type_code,
  * op_code packs up to two ASCII letters little-endian ('g'|'p'<<8).
  * client_tag = (conn_id << 32) | sequenceNumber, for reply routing.
  * p0..p2: numeric params parsed as int64; non-numeric params are
- * interned (shared value table) and returned as ids with bit 62 set. */
+ * interned (shared value table) and returned as ids with bit 62 set.
+ * t0_ns: the client's CLOCK_MONOTONIC send stamp (ClientMessage field
+ * 10 / batch-frame v2 header), 0 when the client didn't stamp — the
+ * service's SLO ledger turns it into e2e latency at reply time. */
 int janus_server_poll_batch(JanusServer* s, int cap,
                             int32_t* type_id, int32_t* key_slot,
                             int32_t* op_code, uint8_t* is_safe,
                             int64_t* p0, int64_t* p1, int64_t* p2,
-                            uint64_t* client_tag, int32_t* n_params);
+                            uint64_t* client_tag, int32_t* n_params,
+                            int64_t* t0_ns);
 
 /* Number of distinct keys seen for a type (key_slot ids are dense). */
 int janus_server_key_count(JanusServer* s, int type_id);

@@ -21,6 +21,9 @@
 //   7 params       repeated string
 //   8 result       string   (reply)
 //   9 response     string   (reply; "su" marks a deferred safe-update ack)
+//  10 t0_ns        varint   (client CLOCK_MONOTONIC send stamp; 0/absent
+//                            = unstamped. Carried opaquely to poll_batch
+//                            for the service's e2e SLO ledger.)
 #include "janus_native.h"
 
 #include <arpa/inet.h>
@@ -51,6 +54,7 @@ struct Op {
   int32_t n_params;  // params the client actually sent (<= 3 retained)
   int64_t p[3];
   uint64_t client_tag;
+  int64_t t0_ns;  // client send stamp (field 10 / batch header); 0 = none
 };
 
 struct Conn {
@@ -85,6 +89,7 @@ struct Parsed {
   uint64_t seq = 0;
   std::string key, type_code, op_code;
   bool is_safe = false;
+  int64_t t0_ns = 0;
   std::vector<std::string> params;
 };
 
@@ -112,6 +117,7 @@ bool parse_client_message(const uint8_t* p, int len, Parsed* m) {
       if (!get_varint(p, end, &v)) return false;
       if (field == 2) m->seq = v;
       if (field == 6) m->is_safe = v != 0;
+      if (field == 10) m->t0_ns = int64_t(v);
     } else if (wt == 2) {
       uint64_t n;
       if (!get_varint(p, end, &n) || p + n > end) return false;
@@ -192,9 +198,11 @@ int64_t le64s(const uint8_t* p) { int64_t v; memcpy(&v, p, 8); return v; }
 // bulk-appended to the op queue without per-op protobuf parse or key
 // hashing. Layout after the field-0 length prefix:
 //   u8   magic = 0x00 (invalid as a protobuf tag: field 0 is illegal)
-//   u8   version = 1
+//   u8   version = 1 or 2
 //   u8   tc_len;  bytes type_code
 //   u32  seq0     (op i's seq = seq0 + i; client bumps its seq by M)
+//   i64  t0_ns    (version >= 2 only: client CLOCK_MONOTONIC send stamp
+//                  shared by every op in the frame; v1 frames -> 0)
 //   u16  n_keys;  n_keys x { u16 len; bytes name }  (frame-local dict)
 //   u32  M
 //   i32  key_idx[M]   (index into the frame's key dict)
@@ -203,14 +211,20 @@ int64_t le64s(const uint8_t* p) { int64_t v; memcpy(&v, p, 8); return v; }
 //   i64  p0[M]
 void JanusServer::handle_batch(uint32_t cid, const uint8_t* p, int len) {
   const uint8_t* end = p + len;
-  if (len < 3 || p[1] != 1) return;  // magic checked by caller
+  if (len < 3 || (p[1] != 1 && p[1] != 2)) return;  // magic checked by caller
+  const int ver = p[1];
   int tc_len = p[2];
   p += 3;
-  if (p + tc_len + 4 + 2 > end) return;
+  if (p + tc_len + 4 + (ver >= 2 ? 8 : 0) + 2 > end) return;
   std::string tc(reinterpret_cast<const char*>(p), size_t(tc_len));
   p += tc_len;
   uint32_t seq0 = le32(p);
   p += 4;
+  int64_t t0_ns = 0;
+  if (ver >= 2) {
+    t0_ns = le64s(p);
+    p += 8;
+  }
   int n_keys = le16(p);
   p += 2;
   std::vector<int32_t> slot_of(size_t(n_keys), -1);
@@ -257,6 +271,7 @@ void JanusServer::handle_batch(uint32_t cid, const uint8_t* p, int len) {
       op.is_safe = sf[i] ? 1 : 0;
       op.n_params = 1;
       op.p[0] = le64s(pp + size_t(i) * 8);
+      op.t0_ns = t0_ns;
       op.client_tag = (uint64_t(cid) << 32) | ((seq0 + i) & 0xffffffff);
       queue.push_back(op);
       appended++;
@@ -270,6 +285,7 @@ void JanusServer::handle_payload(uint32_t cid, const uint8_t* p, int len) {
   if (!parse_client_message(p, len, &m)) return;
   Op op{};
   op.client_tag = (uint64_t(cid) << 32) | (m.seq & 0xffffffff);
+  op.t0_ns = m.t0_ns;
   {
     std::lock_guard<std::mutex> lk(mu);
     int tid = type_id_of(m.type_code);
@@ -454,7 +470,7 @@ extern "C" int janus_server_poll_batch(JanusServer* s, int cap,
                                        int32_t* op_code, uint8_t* is_safe,
                                        int64_t* p0, int64_t* p1, int64_t* p2,
                                        uint64_t* client_tag,
-                                       int32_t* n_params) {
+                                       int32_t* n_params, int64_t* t0_ns) {
   std::lock_guard<std::mutex> lk(s->mu);
   int n = 0;
   while (n < cap && !s->queue.empty()) {
@@ -468,6 +484,7 @@ extern "C" int janus_server_poll_batch(JanusServer* s, int cap,
     p2[n] = op.p[2];
     client_tag[n] = op.client_tag;
     n_params[n] = op.n_params;
+    t0_ns[n] = op.t0_ns;
     s->queue.pop_front();
     n++;
   }

@@ -69,7 +69,7 @@ def load() -> ctypes.CDLL:
         lib.janus_server_register_type.restype = c.c_int
         lib.janus_server_poll_batch.argtypes = [
             c.c_void_p, c.c_int, i32p, i32p, i32p, u8p, i64p, i64p, i64p,
-            u64p, i32p,
+            u64p, i32p, i64p,
         ]
         lib.janus_server_poll_batch.restype = c.c_int
         lib.janus_server_key_count.argtypes = [c.c_void_p, c.c_int]
@@ -187,7 +187,8 @@ class NativeServer:
     def poll_batch(self, cap: int):
         """Drain up to ``cap`` parsed ops. Returns a dict of numpy arrays
         (length = actual count): type_id, key_slot, op_code, is_safe,
-        p0..p2, client_tag.
+        p0..p2, client_tag, n_params, t0_ns (client send stamp; 0 when
+        the client didn't stamp).
 
         The returned arrays are VIEWS into per-server buffers reused by
         the next poll_batch call — consume (or copy) them before polling
@@ -205,6 +206,7 @@ class NativeServer:
                 "p2": np.empty(cap, np.int64),
                 "client_tag": np.empty(cap, np.uint64),
                 "n_params": np.empty(cap, np.int32),
+                "t0_ns": np.empty(cap, np.int64),
             }
             self._poll_cap = cap
         b = self._poll_bufs
@@ -218,7 +220,7 @@ class NativeServer:
             ptr(b["op_code"], c.c_int32), ptr(b["is_safe"], c.c_uint8),
             ptr(b["p0"], c.c_int64), ptr(b["p1"], c.c_int64),
             ptr(b["p2"], c.c_int64), ptr(b["client_tag"], c.c_uint64),
-            ptr(b["n_params"], c.c_int32),
+            ptr(b["n_params"], c.c_int32), ptr(b["t0_ns"], c.c_int64),
         )
         return {f: v[:n] for f, v in b.items()}
 

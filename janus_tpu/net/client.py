@@ -48,8 +48,10 @@ def _read_varint(buf: bytes, off: int):
 
 def encode_client_message(seq: int, key: str, type_code: str, op_code: str,
                           params: Iterable[str] = (), is_safe: bool = False,
-                          source_type: int = 0) -> bytes:
-    """One ClientMessage payload (fields per server.cc:13-23)."""
+                          source_type: int = 0, t0_ns: int = 0) -> bytes:
+    """One ClientMessage payload (fields per server.cc:13-26). ``t0_ns``
+    is the client's CLOCK_MONOTONIC send stamp (field 10); 0 omits the
+    field and the op counts as unstamped in the service's SLO ledger."""
     out = bytearray()
 
     def put_uint(field: int, v: int):
@@ -70,6 +72,8 @@ def encode_client_message(seq: int, key: str, type_code: str, op_code: str,
     put_uint(6, 1 if is_safe else 0)
     for p in params:
         put_str(7, str(p))
+    if t0_ns > 0:
+        put_uint(10, t0_ns)
     return bytes(out)
 
 
@@ -90,19 +94,24 @@ def frame0(payload: bytes) -> bytes:
 
 def encode_batch_frame(seq0: int, type_code: str, keys: Sequence[str],
                        key_idx: np.ndarray, op_codes: np.ndarray,
-                       is_safe: np.ndarray, p0: np.ndarray) -> bytes:
+                       is_safe: np.ndarray, p0: np.ndarray,
+                       t0_ns: int = 0) -> bytes:
     """One columnar batch-frame payload (server.cc handle_batch layout):
     M same-type single-letter update ops as packed little-endian numpy
     columns. Op i's wire sequence is ``seq0 + i``. The column bytes are
     ``.tobytes()`` of the caller's arrays — no per-op encode loop, which
-    is what lets a Python client offer >1M ops/s."""
+    is what lets a Python client offer >1M ops/s. ``t0_ns`` rides the
+    version-2 frame header once for the whole frame (every op in a frame
+    shares one send instant); the server still accepts v1 frames, whose
+    ops count as unstamped."""
     tc = type_code.encode()
     head = bytearray()
     head.append(0x00)            # magic: invalid as a protobuf tag
-    head.append(1)               # version
+    head.append(2)               # version (2 = header carries t0_ns)
     head.append(len(tc))
     head.extend(tc)
     head.extend(struct.pack("<I", seq0 & 0xFFFFFFFF))
+    head.extend(struct.pack("<q", t0_ns))
     head.extend(struct.pack("<H", len(keys)))
     for k in keys:
         kb = k.encode()
@@ -233,8 +242,10 @@ class JanusClient:
             # consensus ack (service._ingest routes by op code)
             if is_safe and op_code not in ("s", "gp", "gs", "sp", "ss", "g"):
                 self._safe_seqs.add(seq)
+        # e2e SLO stamp: CLOCK_MONOTONIC is system-wide on Linux, so the
+        # service (same host) can subtract it at reply time (obs/slo.py)
         msg = encode_client_message(seq, key, type_code, op_code, params,
-                                    is_safe)
+                                    is_safe, t0_ns=time.monotonic_ns())
         with self._send_lock:
             self.sock.sendall(frame0(msg))
         return seq
@@ -261,7 +272,8 @@ class JanusClient:
             for i in np.nonzero(safe)[0].tolist():
                 self._safe_seqs.add(seq0 + int(i))
         payload = encode_batch_frame(seq0, type_code, keys, key_idx,
-                                     op_codes, safe, p0)
+                                     op_codes, safe, p0,
+                                     t0_ns=time.monotonic_ns())
         with self._send_lock:
             self.sock.sendall(frame0(payload))
         return range(seq0, seq0 + m)
@@ -405,7 +417,8 @@ class BatchSender:
         seq0 = self._seq + 1
         self._seq += m
         payload = encode_batch_frame(seq0, type_code, keys, key_idx,
-                                     op_codes, safe, p0)
+                                     op_codes, safe, p0,
+                                     t0_ns=time.monotonic_ns())
         self.sock.sendall(frame0(payload))
         return m
 

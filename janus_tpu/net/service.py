@@ -41,9 +41,10 @@ from janus_tpu.obs import AdaptiveTick, SchedulerConfig
 from janus_tpu.obs import flight as obs_flight
 from janus_tpu.obs import metrics as obs_metrics
 from janus_tpu.obs import stages as obs_stages
+from janus_tpu.obs import slo as obs_slo
 from janus_tpu.obs.export import render_prometheus
 from janus_tpu.obs.traceview import chrome_trace_json
-from janus_tpu.obs.watchdog import HealthWatchdog, WatchdogConfig
+from janus_tpu.obs.watchdog import HealthWatchdog, WatchdogConfig, merge_health
 from janus_tpu.ops.lattice import SENTINEL
 from janus_tpu.runtime.keyspace import ReplicatedKeySpace, shard_of
 from janus_tpu.runtime.safecrdt import SafeKV
@@ -123,6 +124,11 @@ class JanusConfig:
     # where anomaly-triggered flight-recorder dumps land ("" -> never
     # write files; the recorder itself is enabled via obs.flight.enable)
     flight_dump_dir: str = ""
+    # out-of-band obs endpoint (obs/httpexp.py): >= 0 starts an HTTP
+    # thread serving /metrics /stats /health /slo /trace from the live
+    # registry with NO data-plane queueing (0 -> ephemeral port,
+    # advertised via JanusService.obs_port). -1 disables it.
+    obs_port: int = -1
     log_level: str = "info"  # debug|info|warning|error|off (Globals.cs
     # verbosity analog, threaded to every component logger)
     types: Tuple[TypeConfig, ...] = (
@@ -170,6 +176,7 @@ class JanusConfig:
             ingest_wait_ms=float(raw.get("ingest_wait_ms", 10.0)),
             watchdog_stall_ticks=int(raw.get("watchdog_stall_ticks", 200)),
             flight_dump_dir=raw.get("flight_dump_dir", ""),
+            obs_port=int(raw.get("obs_port", -1)),
             log_level=raw.get("log_level", "info"),
             types=types,
             procs=procs,
@@ -215,8 +222,9 @@ class _TypeRuntime:
         self.slot_capacity = dims.get("capacity")
         self.rks = ReplicatedKeySpace(cfg.num_nodes, tcfg.num_keys)
         self.known_keys: set = set()      # creates ever seen (any state)
-        # wire key -> [(client_tag, home)] awaiting create materialization
-        self.create_tags: Dict[int, List[Tuple[int, int]]] = {}
+        # wire key -> [(client_tag, home, t0_ns)] awaiting create
+        # materialization
+        self.create_tags: Dict[int, List[Tuple[int, int, int]]] = {}
         self.minters = [TagMinter(v) for v in range(cfg.num_nodes)]
         # per-home-node FIFO awaiting a block, in ARRIVAL order. Two
         # entry shapes share one queue so per-connection op order is
@@ -237,8 +245,8 @@ class _TypeRuntime:
         # eligibility; filled as slots materialize)
         self.fast_slot = np.full((cfg.num_nodes, tcfg.num_keys), -1,
                                  np.int32)
-        # (slot, node, b) -> client_tag for deferred safe acks
-        self.ack_map: Dict[Tuple[int, int, int], int] = {}
+        # (slot, node, b) -> (client_tag, t0_ns) for deferred safe acks
+        self.ack_map: Dict[Tuple[int, int, int], Tuple[int, int]] = {}
         # device-resident zero batch for idle keep-alive rounds (rebuilt
         # host uploads every tick would ride each idle dispatch)
         self.idle_batch = None
@@ -317,8 +325,9 @@ def _combine_lanes(cols: Dict[str, np.ndarray],
     np.add.at(sums, np.searchsorted(uniq, code),
               cols["a0"][u].astype(np.int64))
     reps = cols["tag"][u][first]
+    reps_t0 = cols["t0"][u][first]
     cap = 2**31 - 1  # device lanes are int32; split larger sums
-    ops_l, keys_l, a0_l, tag_l = [], [], [], []
+    ops_l, keys_l, a0_l, tag_l, t0_l = [], [], [], [], []
     for i, tot in enumerate(sums.tolist()):
         while True:
             part = min(tot, cap)
@@ -326,6 +335,7 @@ def _combine_lanes(cols: Dict[str, np.ndarray],
             keys_l.append(int(uniq[i]) & 0xFFFFFFFF)
             a0_l.append(part)
             tag_l.append(int(reps[i]))
+            t0_l.append(int(reps_t0[i]))
             tot -= part
             if tot <= 0:
                 break
@@ -347,6 +357,8 @@ def _combine_lanes(cols: Dict[str, np.ndarray],
             [np.ones(len(s_idx), bool), np.zeros(nc, bool)]),
         "tag": np.concatenate(
             [cols["tag"][s_idx], np.asarray(tag_l, np.uint64)]),
+        "t0": np.concatenate(
+            [cols["t0"][s_idx], np.asarray(t0_l, np.int64)]),
     }
 
 
@@ -359,7 +371,7 @@ def _merge_combined(a: dict, b: dict, limit: int) -> Optional[dict]:
     matter how many polls fed it. Returns None if the merged form would
     exceed ``limit`` lanes (callers then queue ``b`` separately)."""
     cat = {f: np.concatenate([a[f], b[f]])
-           for f in ("op", "key", "a0", "a1", "a2", "safe", "tag")}
+           for f in ("op", "key", "a0", "a1", "a2", "safe", "tag", "t0")}
     out = _combine_lanes(cat, limit)
     if out is None:
         return None
@@ -406,6 +418,7 @@ _POLL_FIELDS = (
     ("type_id", np.int32), ("key_slot", np.int32), ("op_code", np.int32),
     ("is_safe", np.uint8), ("n_params", np.int32), ("p0", np.int64),
     ("p1", np.int64), ("p2", np.int64), ("client_tag", np.uint64),
+    ("t0_ns", np.int64),
 )
 
 
@@ -419,9 +432,18 @@ _STATS_SAME = frozenset({"slot_capacity"})
 
 def _merge_type_stats(snaps: List[dict]) -> dict:
     """Fold one type's per-shard stats snapshots into a single dict of
-    the same shape (the `stats` command merge)."""
+    the same shape (the `stats` command merge). Iterates the UNION of
+    keys in first-seen order — federation can hand this version-skewed
+    snapshots whose key sets differ, and an empty list folds to {}."""
     out: Dict[str, object] = {}
-    for k in snaps[0]:
+    keys: List[str] = []
+    seen = set()
+    for s in snaps:
+        for k in s:
+            if k not in seen:
+                seen.add(k)
+                keys.append(k)
+    for k in keys:
         vals = [s.get(k) for s in snaps]
         nums = [v for v in vals
                 if isinstance(v, (int, float)) and not isinstance(v, bool)]
@@ -554,11 +576,27 @@ class JanusService:
         self._trace_tid = self.server.register_type("trace", 1)
         self._h_ingest = obs_stages.stage_histograms(f"svc{sfx}")["ingest"]
         # liveness watchdog fed once per step per type; dumps the flight
-        # recorder on first anomaly when a dump dir is configured
+        # recorder on first anomaly when a dump dir is configured. Shard
+        # workers (and split procs) tag their dump files so instances
+        # sharing a dump dir never overwrite each other's evidence.
+        wd_tag = (f"s{self._shard_id}" if sfx
+                  else (f"p{cfg.proc_index}" if cfg.split else ""))
         self.watchdog = HealthWatchdog(WatchdogConfig(
             stall_ticks=cfg.watchdog_stall_ticks,
-            dump_dir=cfg.flight_dump_dir or None))
+            dump_dir=cfg.flight_dump_dir or None,
+            tag=wd_tag))
         self._flight = obs_flight.get_recorder()
+        # flight-recorder trace-id prefix: shard workers qualify the
+        # per-op c{tag} ids so two shards tracing the same client tag
+        # stay distinguishable in one process-wide ring
+        self._trace_pfx = f"s{self._shard_id}." if sfx else ""
+        # per-op e2e SLO ledger (obs/slo.py): reply-time latency by op
+        # class + offered/admitted/replied counters. The front-end holds
+        # none — it aggregates worker ledgers at scrape time.
+        self.slo = (None if self._front
+                    else obs_slo.SloLedger(scope=sfx))
+        self._obs_http = None
+        self.obs_port = -1  # actual port once the endpoint is up
         # stable cross-process element ids (split mode): interned param
         # id -> hashed element id
         self._elem_cache: Dict[int, int] = {}
@@ -656,6 +694,15 @@ class JanusService:
                 rt.node.start()
         for w in self.workers:
             w.start(pump=pump, interval=interval)
+        if self.cfg.obs_port >= 0 and self._shard_id is None:
+            # out-of-band obs plane: one HTTP thread per process serving
+            # the live registry; shard workers share the front's endpoint
+            # (its routes merge their ledgers/watchdogs)
+            from janus_tpu.obs.httpexp import ObsHttpServer
+            self._obs_http = ObsHttpServer(
+                self._obs_routes(), bind_addr=self.cfg.bind_addr,
+                port=self.cfg.obs_port)
+            self.obs_port = self._obs_http.port
         if pump:
             self._running = True
             self._thread = threading.Thread(
@@ -678,6 +725,9 @@ class JanusService:
                 time.sleep(max(interval, 0.001))
 
     def stop(self):
+        if self._obs_http is not None:
+            self._obs_http.close()
+            self._obs_http = None
         self._running = False
         if self._thread is not None:
             self._thread.join()
@@ -830,6 +880,12 @@ class JanusService:
         reads: List[dict] = []
         if count:
             self.perf.add(count)
+            # SLO plane: admitted = ops this step loop drained; on the
+            # unsharded service the poll is also the offer (the router
+            # bumps per-worker offered at route time otherwise)
+            self.slo.admitted.add(count)
+            if self._inbox is None:
+                self.slo.offered.add(count)
             if self._shard_m is not None:
                 self._shard_m["ops_total"].add(count)
             slow_idx = self._ingest_columnar(polled, reads)
@@ -860,6 +916,7 @@ class JanusService:
                     "p0": int(polled["p0"][i]),
                     "p1": int(polled["p1"][i]),
                     "n_params": int(polled["n_params"][i]),
+                    "t0": int(polled["t0_ns"][i]),
                 }, reads, pos=int(i))
         # flush staged queue entries in arrival order (columnar chunks
         # and per-item entries interleave exactly as their ops arrived)
@@ -875,14 +932,15 @@ class JanusService:
                 ingest_ns = time.perf_counter_ns() - t_ingest
                 t1w = time.time_ns()
                 t0w = t1w - max(0, ingest_ns)
+                pfx = self._trace_pfx
                 for lst in self._stage.values():
                     for _pos, e in lst:
                         if e[0] == "chunk":
                             for tg in e[1]["tag"][e[1]["safe"]].tolist():
-                                fl.span_at(f"c{int(tg)}", "ingest",
+                                fl.span_at(f"{pfx}c{int(tg)}", "ingest",
                                            t0w, t1w)
-                        elif e[3]:  # ("item", fields, tag, safe, ckey)
-                            fl.span_at(f"c{int(e[2])}", "ingest",
+                        elif e[3]:  # ("item", fields, tag, safe, ckey, t0)
+                            fl.span_at(f"{pfx}c{int(e[2])}", "ingest",
                                        t0w, t1w)
             limit = min(self.cfg.block_floor, self.cfg.ops_per_block)
             for (tid, v), lst in self._stage.items():
@@ -959,6 +1017,11 @@ class JanusService:
                 continue
             self._reply(it["tag"],
                         self._read(rt, slot, home, it["letters"], it), "ok")
+            # reply-time SLO sample: stable-frontier reads carry the
+            # "stable" contract, prospective reads the local-state one
+            self.slo.observe(
+                "stable" if it["letters"] in ("gs", "ss") else "unsafe",
+                it.get("t0", 0))
         self._step_ms.append(1e3 * (time.perf_counter() - t_step))
         if len(self._step_ms) > 10_000:
             del self._step_ms[:5_000]
@@ -1007,11 +1070,12 @@ class JanusService:
             # slot assignment is total-order position, so creates are
             # serializable (stricter than the reference's local-create-
             # then-replicate, which GUID keying affords it)
-            rt.create_tags.setdefault(key, []).append((tag, home))
+            rt.create_tags.setdefault(key, []).append(
+                (tag, home, it.get("t0", 0)))
             if key not in rt.known_keys:
                 rt.known_keys.add(key)
                 self._stage.setdefault((it["tid"], home), []).append(
-                    (pos, ("item", None, tag, False, key)))
+                    (pos, ("item", None, tag, False, key, 0)))
                 self._pend_inc(tag)
             return
         if key not in rt.known_keys:
@@ -1047,12 +1111,13 @@ class JanusService:
             self._reply(tag, "error: bad param", "err")
             return
         self._stage.setdefault((it["tid"], home), []).append(
-            (pos, ("item", fields, tag, it["safe"], None)))
+            (pos, ("item", fields, tag, it["safe"], None, it.get("t0", 0))))
         self._pend_inc(tag)
         if not it["safe"]:
             # immediate reply for unsafe updates (the op is queued on
             # the home node's next block; ClientInterface.cs:233-242)
             self._reply(tag, "success", "ok")
+            self.slo.observe("unsafe", it.get("t0", 0))
 
     def _conn_has_pending(self, conn_id: int) -> bool:
         return self._conn_pending.get(conn_id, 0) > 0
@@ -1192,7 +1257,7 @@ class JanusService:
                     chunk = {
                         "op": o, "key": rslot[run], "a0": a0,
                         "a1": a1, "a2": a2, "safe": safe_f[run],
-                        "tag": tags[run],
+                        "tag": tags[run], "t0": polled["t0_ns"][run],
                     }
                     if kind == "pnc":
                         chunk = self._combine_pnc_chunk(
@@ -1212,6 +1277,9 @@ class JanusService:
             # connection, vs a Python tuple + frame encode per op.
             # .copy() is load-bearing — poll buffers are reused.
             self._ack_bulk.append(tags[unsafe].copy())
+            # one vectorized SLO sample for the whole bulk ack — this is
+            # the ledger's entire cost on the hot columnar path
+            self.slo.observe_batch("unsafe", polled["t0_ns"][unsafe])
         return self._ingest_residual(polled, fast, reads)
 
     def _combine_pnc_chunk(self, cols: Dict[str, np.ndarray],
@@ -1290,20 +1358,26 @@ class JanusService:
                         "letters": self._read_letters[int(opc[i])],
                         "key": key, "p0": int(p0[i]), "p1": int(p1[i]),
                         "n_params": int(npar[i]),
+                        "t0": int(polled["t0_ns"][i]),
                     })
                 handled[i] = True
             c_idx = np.nonzero(create_m & tm)[0]
             if c_idx.size:
                 done = []
+                done_t0 = []
                 for i in c_idx.tolist():
                     key = self._key_str(rt, t, int(slot_raw[i]))
                     if rt.rks.slot(int(home[i]), key) is not None:
                         # create of an already-materialized key: the
                         # per-item path would ack "success" immediately
                         done.append(int(tags[i]))
+                        done_t0.append(int(polled["t0_ns"][i]))
                         handled[i] = True
                 if done:
                     self._ack_bulk.append(np.asarray(done, np.uint64))
+                    # creates carry the safe (consensus-gated) contract
+                    # even when answered from the materialized table
+                    self.slo.observe_batch("safe", done_t0)
         return np.nonzero(rest & ~handled)[0]
 
     def _op_fields(self, rt: _TypeRuntime, op_id: int, slot: int, home: int,
@@ -1410,10 +1484,11 @@ class JanusService:
             waiters = rt.create_tags.get(key)
             if not waiters:
                 continue
-            still = [(tag, home) for tag, home in waiters if home != v]
-            for tag, home in waiters:
+            still = [w for w in waiters if w[1] != v]
+            for tag, home, t0 in waiters:
                 if home == v:
                     self._reply(tag, "success", "ok")
+                    self.slo.observe("safe", t0)
             if still:
                 rt.create_tags[key] = still
             else:
@@ -1465,7 +1540,7 @@ class JanusService:
         rt.last_payload_t = time.perf_counter()
         batch = {f: np.zeros((n, B), np.int32) for f in base.OP_FIELDS}
         safe = np.zeros((n, B), bool)
-        placed: List[List[Tuple[int, bool, int, Optional[int]]]] = [
+        placed: List[List[Tuple[int, bool, int, Optional[int], int]]] = [
             [] for _ in range(n)]
         # everything popped this step, in board order (for requeue)
         taken: List[List[tuple]] = [[] for _ in range(n)]
@@ -1506,7 +1581,7 @@ class JanusService:
                     taken[v].append(("chunk", head))
                     b += take
                     continue
-                _kind, fields, tag, is_safe, create_key = entry
+                _kind, fields, tag, is_safe, create_key, t0 = entry
                 taken[v].append(entry)
                 if fields is not None:
                     for name, val in fields.items():
@@ -1515,7 +1590,7 @@ class JanusService:
                 # host-side (key, block) binding; only its position in
                 # the committed order matters
                 safe[v, b] = is_safe
-                placed[v].append((b, is_safe, tag, create_key))
+                placed[v].append((b, is_safe, tag, create_key, t0))
                 b += 1
         # record only payload-bearing blocks in latency stats; idle
         # keep-alive rounds must not grow host logs or dilute metrics
@@ -1531,13 +1606,13 @@ class JanusService:
             trace = [None] * n
             for v in range(n):
                 tid_v = None
-                for _b, is_safe, tg, _ck in placed[v]:
+                for _b, is_safe, tg, _ck, _t0 in placed[v]:
                     if tid_v is None or is_safe:
                         tid_v = tg
                         if is_safe:
                             break
                 if tid_v is None or not any(
-                        s for _b, s, _t, _c in placed[v]):
+                        s for _b, s, _t, _c, _t0 in placed[v]):
                     for _b0, head in fast_placed[v]:
                         si = np.nonzero(head["safe"])[0]
                         if si.size:
@@ -1546,7 +1621,7 @@ class JanusService:
                         if tid_v is None:
                             tid_v = int(head["tag"][0])
                 if tid_v is not None:
-                    trace[v] = f"c{int(tid_v)}"
+                    trace[v] = f"{self._trace_pfx}c{int(tid_v)}"
 
         def requeue(v):
             for entry in reversed(taken[v]):
@@ -1565,7 +1640,7 @@ class JanusService:
         accepted, slots = info["accepted"], info["slot"]
         for v in range(n):
             if accepted[v]:
-                for b, is_safe, tag, create_key in placed[v]:
+                for b, is_safe, tag, create_key, t0 in placed[v]:
                     self._pend_dec(tag)
                     if create_key is not None:
                         rnd = int(info["round"][v])
@@ -1577,7 +1652,7 @@ class JanusService:
                             self._fabric.send_create(
                                 rt.index, create_key, rnd, v)
                     if is_safe:
-                        rt.ack_map[(int(slots[v]), v, b)] = tag
+                        rt.ack_map[(int(slots[v]), v, b)] = (tag, t0)
                 for b0, head in fast_placed[v]:
                     pend = head.get("pend")
                     if pend is not None:
@@ -1594,8 +1669,8 @@ class JanusService:
                             self._conn_pending[c] = left
                     sv = int(slots[v])
                     for i in np.nonzero(head["safe"])[0]:
-                        rt.ack_map[(sv, v, b0 + int(i))] = int(
-                            head["tag"][i])
+                        rt.ack_map[(sv, v, b0 + int(i))] = (
+                            int(head["tag"][i]), int(head["t0"][i]))
             else:
                 # slot sealed/back-pressure: requeue in order for the
                 # next block (the reference re-queues uncertified
@@ -1630,10 +1705,11 @@ class JanusService:
         acks = rt.kv.drain_safe_acks()
         for (s, v, b) in list(rt.ack_map):
             if acks[s, v, b]:
-                tag = rt.ack_map.pop((s, v, b))
+                tag, t0 = rt.ack_map.pop((s, v, b))
                 # deferred safe-update ack (NotifySafeUpdateComplete,
                 # ClientInterface.cs:186-190)
                 self._reply(tag, "success", "su")
+                self.slo.observe("safe", t0)
 
     def _read(self, rt: _TypeRuntime, slot: int, home: int, letters: str,
               it: dict) -> str:
@@ -1718,6 +1794,9 @@ class JanusService:
                 # fancy-index COPIES — inbox chunks must not alias the
                 # native poll buffers, which the next poll overwrites
                 w._inbox.put({f: v[m] for f, v in polled.items()})
+                # offered = ops handed to the shard (admitted is bumped
+                # by the worker when its step loop drains them)
+                w.slo.offered.add(int(m.sum()))
         for i in np.nonzero(ctrl)[0].tolist():
             self._ctrl_reply(int(tid_arr[i]),
                              int(polled["client_tag"][i]))
@@ -1805,22 +1884,10 @@ class JanusService:
 
     def _health_merged(self) -> dict:
         """Worst-of across shard watchdogs; reasons and equivocation
-        sources carry an s{K} prefix so the culprit shard is evident."""
-        merged: Dict[str, Any] = {"status": "OK", "reasons": [],
-                                  "anomalies": 0, "dumps": 0,
-                                  "equivocation": {}}
-        order = {"OK": 0, "DEGRADED": 1, "STALLED": 2}
-        for k, w in enumerate(self.workers):
-            h = w.watchdog.health()
-            if order.get(h["status"], 1) > order.get(merged["status"], 0):
-                merged["status"] = h["status"]
-            merged["reasons"].extend(
-                f"s{k}: {r}" for r in h.get("reasons", []))
-            merged["anomalies"] += int(h.get("anomalies", 0))
-            merged["dumps"] += int(h.get("dumps", 0))
-            for src, cnt in (h.get("equivocation") or {}).items():
-                merged["equivocation"][f"s{k}:{src}"] = cnt
-        return merged
+        sources carry an s{K} prefix so the culprit shard is evident
+        (obs.watchdog.merge_health — the same fold federation uses)."""
+        return merge_health([(f"s{k}", w.watchdog.health())
+                             for k, w in enumerate(self.workers)])
 
     # -- in-band telemetry ------------------------------------------------
 
@@ -1879,6 +1946,19 @@ class JanusService:
                                scope=f"dag_{tc}{sfx}")
             tusk.observe_commit(rt.kv.cfg, rt.kv.commit, reg,
                                 scope=f"tusk_{tc}{sfx}")
+        self._refresh_host_gauges()
+
+    def _refresh_host_gauges(self) -> None:
+        """The host-only subset of the scrape refresh (no device
+        fetches) — what the OUT-OF-BAND endpoint runs: an oob scrape
+        must stay answerable while every device queue is saturated,
+        which is exactly when the consensus-state observers above would
+        block behind the data plane."""
+        reg = obs_metrics.get_registry()
+        sfx = (f"_s{self._shard_id}" if self._shard_id is not None
+               and self.cfg.shards > 1 else "")
+        for rt in self.types.values():
+            tc = rt.spec.type_code
             reg.gauge(f"svc_{tc}{sfx}_block_size").set(rt.kv.B)
             reg.gauge(f"svc_{tc}{sfx}_pending_ops").set(
                 _pending_total(rt.pending))
@@ -1897,6 +1977,84 @@ class JanusService:
         reg.gauge("svc_ticks").set(self.ticks)
         reg.gauge("svc_ops_received").set(self.server.ops_received())
         return render_prometheus(reg)
+
+    # -- out-of-band obs plane (obs/httpexp.py) ---------------------------
+
+    def _obs_routes(self) -> Dict[str, Any]:
+        """Route table for the out-of-band HTTP endpoint. Every handler
+        is HOST-ONLY — no device fetches, no data-plane queueing — so a
+        scrape answers within milliseconds even at the overload point
+        where in-band ``stats`` ops sit queue-bound behind the very
+        backlog being diagnosed."""
+
+        def _metrics():
+            reg = obs_metrics.get_registry()
+            if self._front:
+                for w in self.workers:
+                    w._refresh_host_gauges()
+                    w.watchdog.health()  # refresh watchdog_health gauge
+            else:
+                self._refresh_host_gauges()
+                self.watchdog.health()
+            reg.gauge("svc_ticks").set(self.ticks)
+            reg.gauge("svc_ops_received").set(self.server.ops_received())
+            return "text/plain; version=0.0.4", render_prometheus(reg)
+
+        def _json(fn):
+            return lambda: ("application/json", json.dumps(fn()))
+
+        return {
+            "/metrics": _metrics,
+            "/stats": _json(self._stats_oob),
+            "/health": _json(self._health_oob),
+            "/slo": _json(self._slo_snapshot),
+            "/trace": lambda: ("application/json",
+                               chrome_trace_json(self._flight.snapshot())),
+        }
+
+    def _slo_snapshot(self) -> dict:
+        """The ``/slo`` document: one SloLedger snapshot, or (sharded
+        front-end) the merge_slo fold of every worker's — counters and
+        bucket vectors sum, percentiles recompute from merged counts."""
+        if self._front:
+            return obs_slo.merge_slo(
+                [(f"s{k}", w.slo.snapshot())
+                 for k, w in enumerate(self.workers)])
+        return self.slo.snapshot()
+
+    def _health_oob(self) -> dict:
+        return (self._health_merged() if self._front
+                else self.watchdog.health())
+
+    def _stats_oob(self) -> dict:
+        """Reduced host-only stats for ``/stats``. The in-band command's
+        device-derived fields (commit lag, slot occupancy) are
+        deliberately absent — fetching them rides the data plane, and
+        the whole point of this endpoint is not to."""
+        dt = max(time.monotonic() - self._t0, 1e-9)
+        ops = self.server.ops_received()
+        doc: Dict[str, Any] = {
+            "ops_received": ops,
+            "replies_sent": self.server.replies_sent(),
+            "ticks": self.ticks,
+            "uptime_sec": round(dt, 3),
+            "ops_per_sec": round(ops / dt, 1),
+            "perf": self.perf.report(),
+            "health": self._health_oob(),
+            "slo": self._slo_snapshot(),
+        }
+        if self._front:
+            doc["shard_count"] = self.cfg.shards
+            doc["inbox_depth"] = sum(w._inbox.depth for w in self.workers)
+            doc["pending_ops"] = {
+                f"s{k}": sum(_pending_total(rt.pending)
+                             for rt in w.types.values())
+                for k, w in enumerate(self.workers)}
+        else:
+            doc["pending_ops"] = {
+                rt.spec.type_code: _pending_total(rt.pending)
+                for rt in self.types.values()}
+        return doc
 
 
 def main(argv=None) -> None:

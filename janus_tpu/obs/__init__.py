@@ -28,7 +28,17 @@ from janus_tpu.obs.metrics import (  # noqa: F401
     Registry,
     get_registry,
 )
+from janus_tpu.obs.httpexp import (  # noqa: F401
+    ObsHttpServer,
+    federation_routes,
+    merge_prometheus,
+)
 from janus_tpu.obs.scheduler import AdaptiveTick, SchedulerConfig  # noqa: F401
+from janus_tpu.obs.slo import OP_CLASSES, SloLedger, merge_slo  # noqa: F401
 from janus_tpu.obs.stages import STAGES, stage_histograms, time_stage  # noqa: F401
 from janus_tpu.obs.traceview import write_chrome_trace  # noqa: F401
-from janus_tpu.obs.watchdog import HealthWatchdog, WatchdogConfig  # noqa: F401
+from janus_tpu.obs.watchdog import (  # noqa: F401
+    HealthWatchdog,
+    WatchdogConfig,
+    merge_health,
+)

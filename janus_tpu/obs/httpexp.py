@@ -1,0 +1,263 @@
+"""Out-of-band observability endpoint + cluster obs federation.
+
+The service's ``stats``/``metrics``/``health``/``trace`` commands ride
+the data plane: they are ops on the same native queue and shard inboxes
+they describe, so at the overload point where observability matters
+most the plane is exactly as observable as it is healthy — not at all.
+This module is the out-of-band alternative: a stdlib ``http.server``
+thread per process serving the live registry over plain HTTP GET, with
+NO queueing behind the op pipeline. Routes are caller-supplied
+callables; the service wires host-only handlers (no device fetches), so
+a scrape returns promptly even when every worker is saturated.
+
+Federation: in the split cluster each process runs its own endpoint;
+``federation_routes`` gives a front process routes that scrape its
+peers and serve one merged exposition — Prometheus samples gain a
+``node`` label (the registry itself is label-free, so the label is
+spliced into the text exposition at merge time), ``/slo`` merges via
+``obs.slo.merge_slo`` (bucket-vector sums, recomputed percentiles),
+``/health`` via ``obs.watchdog.merge_health`` (worst-of). A dead peer
+degrades to ``obs_peer_up{node="..."} 0`` instead of failing the
+scrape.
+
+The handler accounts its own CPU (``obs_http_cpu_ns`` /
+``obs_http_requests_total`` counters), which is what the bench harness
+uses to bound the plane's goodput perturbation analytically instead of
+with flaky A/B wall-clock runs.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from janus_tpu.obs.metrics import get_registry
+
+# a route: () -> (content_type, body_str)
+Route = Callable[[], Tuple[str, str]]
+
+
+class ObsHttpServer:
+    """Daemon-threaded HTTP server over a path -> route-callable table.
+
+    Binds (and starts serving) in the constructor; ``port`` reports the
+    actual port so ``port=0`` callers can advertise it. Handler errors
+    answer 500 and never take the serving thread down.
+    """
+
+    def __init__(self, routes: Dict[str, Route],
+                 bind_addr: str = "127.0.0.1", port: int = 0,
+                 registry=None):
+        reg = registry if registry is not None else get_registry()
+        c_req = reg.counter("obs_http_requests_total")
+        c_cpu = reg.counter("obs_http_cpu_ns")
+        c_err = reg.counter("obs_http_errors_total")
+        table = dict(routes)
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                t0 = time.thread_time_ns()
+                fn = table.get(self.path.split("?", 1)[0])
+                try:
+                    if fn is None:
+                        code, ctype, body = 404, "text/plain", "not found\n"
+                    else:
+                        ctype, body = fn()
+                        code = 200
+                except Exception as e:  # handler bug must not kill serving
+                    c_err.add()
+                    code, ctype, body = (500, "text/plain",
+                                         f"{type(e).__name__}: {e}\n")
+                data = body.encode()
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except OSError:
+                    pass  # client went away mid-reply
+                c_req.add()
+                c_cpu.add(time.thread_time_ns() - t0)
+
+            def log_message(self, *args):  # noqa: D102
+                pass  # stderr chatter per scrape is not telemetry
+
+        self._httpd = ThreadingHTTPServer((bind_addr, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="obs-http", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+# -- scrape helpers (client side of federation) --------------------------
+
+
+def scrape_text(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def scrape_json(url: str, timeout: float = 5.0) -> dict:
+    return json.loads(scrape_text(url, timeout=timeout))
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+
+
+def merge_prometheus(parts: Sequence[Tuple[str, str]]) -> str:
+    """Merge per-node Prometheus expositions into one, splicing a
+    ``node="label"`` label into every sample (the in-process registry is
+    label-free; federation is where labels enter). Samples stay grouped
+    per metric with one HELP/TYPE header (first writer wins), as the
+    text format requires."""
+    headers: Dict[str, List[str]] = {}
+    samples: Dict[str, List[str]] = {}
+    order: List[str] = []
+
+    def _seen(name: str) -> None:
+        if name not in samples:
+            samples[name] = []
+            order.append(name)
+
+    for label, text in parts:
+        typed: set = set()
+        for line in text.splitlines():
+            line = line.rstrip()
+            if not line:
+                continue
+            if line.startswith("#"):
+                toks = line.split()
+                if len(toks) >= 3 and toks[1] in ("HELP", "TYPE"):
+                    name = toks[2]
+                    if toks[1] == "TYPE":
+                        typed.add(name)
+                    _seen(name)
+                    hs = headers.setdefault(name, [])
+                    if len(hs) < 2 and line not in hs:
+                        hs.append(line)
+                continue
+            m = _SAMPLE_RE.match(line)
+            if not m:
+                continue
+            name, labels, value = m.groups()
+            base = name
+            for suf in ("_bucket", "_sum", "_count"):
+                if name.endswith(suf) and name[: -len(suf)] in typed:
+                    base = name[: -len(suf)]
+                    break
+            _seen(base)
+            inner = (labels or "{}")[1:-1]
+            merged = (f'node="{label}"' + ("," + inner if inner else ""))
+            samples[base].append(f"{name}{{{merged}}} {value}")
+    out: List[str] = []
+    for name in order:
+        out.extend(headers.get(name, ()))
+        out.extend(samples.get(name, ()))
+    return "\n".join(out) + "\n"
+
+
+def federation_routes(peers: Sequence[Tuple[str, str]],
+                      timeout: float = 2.0) -> Dict[str, Route]:
+    """Routes for a federating front process: each handler fans out to
+    ``peers`` = [(label, base_url)] and serves the merged view. A peer
+    that fails to answer within ``timeout`` is reported down
+    (``obs_peer_up{node=...} 0`` on /metrics, ``up: false`` in the JSON
+    routes) — a wedged worker host must never wedge the cluster scrape.
+    """
+    from janus_tpu.obs.slo import merge_slo
+    from janus_tpu.obs.watchdog import merge_health
+
+    def _fan(path: str):
+        good, up = [], {}
+        for label, base in peers:
+            try:
+                good.append((label,
+                             scrape_text(base.rstrip("/") + path,
+                                         timeout=timeout)))
+                up[label] = True
+            except Exception:
+                up[label] = False
+        return good, up
+
+    def _metrics() -> Tuple[str, str]:
+        good, up = _fan("/metrics")
+        text = merge_prometheus(good)
+        text += "# TYPE obs_peer_up gauge\n" + "".join(
+            f'obs_peer_up{{node="{lb}"}} {1 if ok else 0}\n'
+            for lb, ok in up.items())
+        return "text/plain; version=0.0.4", text
+
+    def _slo() -> Tuple[str, str]:
+        good, up = _fan("/slo")
+        merged = merge_slo([(lb, json.loads(t)) for lb, t in good])
+        merged["up"] = up
+        return "application/json", json.dumps(merged)
+
+    def _health() -> Tuple[str, str]:
+        good, up = _fan("/health")
+        # an unreachable peer merges as a DEGRADED verdict of its own —
+        # merge_health's worst-of then escalates the cluster status
+        down = [(lb, {"status": "DEGRADED",
+                      "reasons": ["obs endpoint unreachable"]})
+                for lb, ok in up.items() if not ok]
+        merged = merge_health(
+            [(lb, json.loads(t)) for lb, t in good] + down)
+        merged["up"] = up
+        return "application/json", json.dumps(merged)
+
+    def _stats() -> Tuple[str, str]:
+        good, up = _fan("/stats")
+        doc = {"up": up,
+               "nodes": {lb: json.loads(t) for lb, t in good}}
+        return "application/json", json.dumps(doc)
+
+    return {"/metrics": _metrics, "/slo": _slo, "/health": _health,
+            "/stats": _stats}
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """Standalone federation endpoint:
+
+        python -m janus_tpu.obs.httpexp --port 9100 \\
+            --peer s0=http://127.0.0.1:9101 --peer s1=http://127.0.0.1:9102
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("--port", type=int, default=9100)
+    ap.add_argument("--bind", default="127.0.0.1")
+    ap.add_argument("--peer", action="append", default=[],
+                    metavar="LABEL=URL")
+    ap.add_argument("--timeout", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    peers = []
+    for spec in args.peer:
+        label, _, url = spec.partition("=")
+        if not url:
+            ap.error(f"--peer wants LABEL=URL, got {spec!r}")
+        peers.append((label, url))
+    srv = ObsHttpServer(federation_routes(peers, timeout=args.timeout),
+                        bind_addr=args.bind, port=args.port)
+    print(f"obs federation endpoint on {args.bind}:{srv.port} "
+          f"({len(peers)} peers)", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.close()
+
+
+if __name__ == "__main__":
+    main()

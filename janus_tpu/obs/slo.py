@@ -1,0 +1,182 @@
+"""Per-op end-to-end SLO ledger: client-observed latency by op class.
+
+Janus's whole point is that its three consistency classes carry
+different latency contracts — unsafe updates and prospective reads
+answer from local state immediately, safe updates wait for consensus,
+stable reads wait for the stable frontier — yet the obs plane so far
+measured only server-internal stage times (``step_ms``, seal latency),
+never what a client actually waits. This module closes that gap:
+
+- Clients stamp ``t0_ns = time.monotonic_ns()`` into every wire frame
+  (ClientMessage field 10; batch-frame v2 header). CLOCK_MONOTONIC is
+  system-wide on Linux, so a service on the SAME HOST can subtract the
+  stamp at reply time; cross-host federation reports each host's own
+  ledger rather than comparing clocks.
+- The service calls ``observe``/``observe_batch`` wherever it emits a
+  data reply, tagging the op's class. ``t0_ns <= 0`` means the client
+  didn't stamp (old clients, v1 batch frames, native loadgen): the op
+  still counts in the ``replied`` counters but records no latency.
+- Offered / admitted / replied / shed counters make goodput and shed
+  rate first-class instruments instead of harness post-processing:
+  *offered* = ops handed to the service instance (router-side per
+  shard), *admitted* = ops its step loop drained, *replied* = data
+  replies sent per class, *shed* = ops dropped by admission control
+  (always 0 until the overload controller lands; the instrument exists
+  so the controller has somewhere to account).
+
+Everything lands in the process-wide metrics registry (names carry the
+ledger's ``scope`` — the service's per-shard ``_s{K}`` suffix), so the
+Prometheus exposition and the out-of-band ``/slo`` endpoint both see it
+with zero extra plumbing. ``merge_slo`` folds per-shard snapshots into
+one cluster view by SUMMING bucket vectors and recomputing percentiles
+from the merged counts (percentile-of-percentiles would be wrong).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from janus_tpu.obs.metrics import (NUM_BUCKETS, Histogram, Registry,
+                                   get_registry, percentile_from_counts)
+
+# unsafe: local-state answers (unsafe updates, prospective gp/sp reads)
+# safe:   consensus-gated acks (safe updates, creates)
+# stable: stable-frontier reads (gs/ss)
+OP_CLASSES = ("unsafe", "safe", "stable")
+
+
+def classify(letters: str, is_safe: bool) -> str:
+    """Map a wire op code + safe flag to its SLO class."""
+    if letters in ("gs", "ss"):
+        return "stable"
+    if letters in ("gp", "sp", "g"):
+        return "unsafe"
+    return "safe" if is_safe else "unsafe"
+
+
+class SloLedger:
+    """One service instance's SLO instruments, scoped into the registry.
+
+    ``scope`` follows the service's shard-suffix convention (``""`` for
+    an unsharded service, ``_s{K}`` for worker K) since the registry has
+    no label support — the same choice as ``shard_instruments``.
+    """
+
+    def __init__(self, scope: str = "",
+                 registry: Optional[Registry] = None):
+        reg = registry if registry is not None else get_registry()
+        self.scope = scope
+        self.e2e: Dict[str, Histogram] = {
+            c: reg.histogram(f"slo{scope}_e2e_{c}_ns") for c in OP_CLASSES
+        }
+        self.offered = reg.counter(f"slo{scope}_offered_total")
+        self.admitted = reg.counter(f"slo{scope}_admitted_total")
+        self.shed = reg.counter(f"slo{scope}_shed_total")
+        self.replied: Dict[str, object] = {
+            c: reg.counter(f"slo{scope}_replied_{c}_total")
+            for c in OP_CLASSES
+        }
+
+    # -- reply-time sampling --------------------------------------------
+
+    def observe(self, cls: str, t0_ns: int,
+                now_ns: Optional[int] = None) -> None:
+        """Account one data reply; records e2e latency iff stamped."""
+        self.replied[cls].add()
+        if t0_ns <= 0:
+            return
+        now = time.monotonic_ns() if now_ns is None else now_ns
+        self.e2e[cls].record(now - t0_ns)  # record clamps negatives to 0
+
+    def observe_batch(self, cls: str, t0_ns,
+                      now_ns: Optional[int] = None) -> None:
+        """Account a bulk-ack flush (one class, many ops). One clock
+        read and one vectorized histogram update for the whole batch —
+        the ledger's cost on the hot unsafe-ack path."""
+        t0 = np.asarray(t0_ns, np.int64).ravel()
+        n = int(t0.size)
+        if n == 0:
+            return
+        self.replied[cls].add(n)
+        # fast path: a batch from one stamping client is all-stamped, so
+        # one min() reduction replaces mask + any + boolean-index copy
+        if int(t0.min()) > 0:
+            now = time.monotonic_ns() if now_ns is None else now_ns
+            self.e2e[cls].record_many(now - t0)
+            return
+        stamped = t0 > 0
+        if not stamped.any():
+            return
+        now = time.monotonic_ns() if now_ns is None else now_ns
+        self.e2e[cls].record_many(now - t0[stamped])
+
+    # -- exposition ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-shaped view for the ``/slo`` endpoint. Includes the raw
+        64-bucket count vectors so ``merge_slo`` can recompute merged
+        percentiles instead of averaging per-shard ones."""
+        classes = {}
+        for c, h in self.e2e.items():
+            classes[c] = {
+                "replied": int(self.replied[c].value),
+                "e2e_samples": h.count,
+                "e2e_p50_ms": round(h.percentile(0.50) / 1e6, 3),
+                "e2e_p99_ms": round(h.percentile(0.99) / 1e6, 3),
+                "counts": h.counts(),
+            }
+        return {
+            "scope": self.scope,
+            "classes": classes,
+            "offered": int(self.offered.value),
+            "admitted": int(self.admitted.value),
+            "shed": int(self.shed.value),
+            "replied_total": sum(int(self.replied[c].value)
+                                 for c in OP_CLASSES),
+        }
+
+
+def merge_slo(parts: List[Tuple[str, dict]]) -> dict:
+    """Fold labeled per-instance ``SloLedger.snapshot()`` dicts into one
+    cluster view: counters sum, bucket vectors sum, and per-class
+    p50/p99 are recomputed from the MERGED counts. Each input snapshot
+    also survives (sans bucket vectors) under ``nodes[label]`` so a
+    scrape can still attribute latency to a shard/host."""
+    counts = {c: [0] * NUM_BUCKETS for c in OP_CLASSES}
+    classes = {c: {"replied": 0, "e2e_samples": 0} for c in OP_CLASSES}
+    out = {"offered": 0, "admitted": 0, "shed": 0, "replied_total": 0,
+           "nodes": {}}
+    for label, snap in parts:
+        for k in ("offered", "admitted", "shed", "replied_total"):
+            out[k] += int(snap.get(k, 0))
+        for c in OP_CLASSES:
+            cs = (snap.get("classes") or {}).get(c) or {}
+            classes[c]["replied"] += int(cs.get("replied", 0))
+            classes[c]["e2e_samples"] += int(cs.get("e2e_samples", 0))
+            vec = cs.get("counts")
+            if vec:
+                acc = counts[c]
+                for i, v in enumerate(vec[:NUM_BUCKETS]):
+                    acc[i] += int(v)
+        out["nodes"][label] = {
+            "classes": {
+                c: {k: v
+                    for k, v in ((snap.get("classes") or {})
+                                 .get(c, {})).items()
+                    if k != "counts"}
+                for c in OP_CLASSES
+            },
+            "offered": int(snap.get("offered", 0)),
+            "admitted": int(snap.get("admitted", 0)),
+            "shed": int(snap.get("shed", 0)),
+        }
+    for c in OP_CLASSES:
+        classes[c]["e2e_p50_ms"] = round(
+            percentile_from_counts(counts[c], 0.50) / 1e6, 3)
+        classes[c]["e2e_p99_ms"] = round(
+            percentile_from_counts(counts[c], 0.99) / 1e6, 3)
+        classes[c]["counts"] = counts[c]
+    out["classes"] = classes
+    return out

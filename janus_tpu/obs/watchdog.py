@@ -48,6 +48,11 @@ class WatchdogConfig:
     overflow_streak: int = 16     # consecutive overflow ticks -> DEGRADED
     equivocation_limit: int = 0   # pruned blocks tolerated per node
     dump_dir: Optional[str] = None  # None -> never write dump files
+    # dump-file qualifier for instances SHARING a dump_dir (shard
+    # workers, split-cluster processes): each watchdog counts its own
+    # dumps, so without a tag shard 0's flight_commit_stall_1.jsonl
+    # silently overwrites shard 1's
+    tag: str = ""
 
 
 class HealthWatchdog:
@@ -157,8 +162,9 @@ class HealthWatchdog:
             return
         self._dumps += 1
         os.makedirs(self.cfg.dump_dir, exist_ok=True)
+        tag = f"_{self.cfg.tag}" if self.cfg.tag else ""
         path = os.path.join(self.cfg.dump_dir,
-                            f"flight_{anomaly}_{self._dumps}.jsonl")
+                            f"flight_{anomaly}{tag}_{self._dumps}.jsonl")
         try:
             rec.dump(path)
         except OSError:
@@ -179,3 +185,29 @@ class HealthWatchdog:
         return {"status": level, "reasons": reasons,
                 "anomalies": len(self._active), "dumps": self._dumps,
                 "equivocation": dict(self._equiv)}
+
+
+def merge_health(parts: List) -> dict:
+    """Worst-of fold of labeled ``health()`` snapshots — the cluster
+    verdict for a sharded service or a federated scrape. ``parts`` is
+    ``[(label, health_dict)]``; reasons and equivocation sources gain a
+    ``label:`` prefix so the culprit instance stays identifiable. An
+    empty list folds to a clean OK verdict; a status string outside the
+    known set (version-skewed peer) is itself surfaced as DEGRADED
+    rather than silently dropped or trusted."""
+    merged = {"status": OK, "reasons": [], "anomalies": 0, "dumps": 0,
+              "equivocation": {}}
+    for label, h in parts:
+        st = str(h.get("status", OK))
+        if st not in _LEVEL:
+            merged["reasons"].append(f"{label}: unknown status {st!r}")
+            st = DEGRADED
+        if _LEVEL[st] > _LEVEL[merged["status"]]:
+            merged["status"] = st
+        merged["reasons"].extend(
+            f"{label}: {r}" for r in h.get("reasons", ()))
+        merged["anomalies"] += int(h.get("anomalies", 0))
+        merged["dumps"] += int(h.get("dumps", 0))
+        for src, n in (h.get("equivocation") or {}).items():
+            merged["equivocation"][f"{label}:{src}"] = n
+    return merged

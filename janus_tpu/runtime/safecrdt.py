@@ -177,14 +177,18 @@ class SafeKV:
         self._stage = obs_stages.stage_histograms(self.stage_scope)
         # causal tracing: the process flight recorder (disabled by
         # default — every hook below is guarded on .enabled) and the
-        # live op->block map: (slot, node) -> trace_id, registered when
-        # a traced payload seals into a block, dropped at own-view
-        # commit or slot recycle. Block-level on purpose: a block is
-        # the unit the DAG orders, so every op riding it shares the
-        # block's consensus fate (the elected trace id is the block's
-        # representative op).
+        # live op->block map: (slot, node) -> (trace_id, seal_t0_ns),
+        # registered when a traced payload seals into a block, dropped
+        # at own-view commit or slot recycle. Block-level on purpose: a
+        # block is the unit the DAG orders, so every op riding it shares
+        # the block's consensus fate (the elected trace id is the
+        # block's representative op). The seal span's wall-clock start
+        # rides along so the commit span can anchor on the SAME
+        # back-dated instant — deriving it again from perf_counter
+        # deltas puts two clock-domain conversions in a race and the
+        # commit span can start nanoseconds before the seal it follows.
         self._flight = obs_flight.get_recorder()
-        self._block_traces: Dict[tuple, str] = {}
+        self._block_traces: Dict[tuple, tuple] = {}
         self._jit_submit = jax.jit(self._submit_device)
         self._jit_tick = jax.jit(self._tick_device)
         self._jit_step = jax.jit(self._step_device)
@@ -738,13 +742,16 @@ class SafeKV:
             if fl.enabled and self._block_traces:
                 t1w = time.time_ns()
                 for slot, v in zip(*np.nonzero(newly)):
-                    tid = self._block_traces.pop((int(slot), int(v)), None)
-                    if tid is None:
+                    ent = self._block_traces.pop((int(slot), int(v)), None)
+                    if ent is None:
                         continue
-                    wsec = now - self.submit_wall[slot, v]
-                    if not np.isfinite(wsec) or wsec < 0:
-                        wsec = 0.0
-                    fl.span_at(tid, "commit", t1w - int(wsec * 1e9), t1w)
+                    tid, wall0 = ent
+                    # start exactly where the seal span started: same
+                    # anchor -> span_chains' stable time sort keeps the
+                    # emission order seal < commit, and the duration is
+                    # the submit->commit wall latency measured in one
+                    # clock domain
+                    fl.span_at(tid, "commit", min(wall0, t1w), t1w)
                     traced_commits.append(tid)
         for log in (self.latency_log, self.wall_latency_log):
             if len(log) > self.max_latency_log:
@@ -759,7 +766,7 @@ class SafeKV:
                 # a recycled slot's trace (committed ones popped above)
                 # died uncommitted — abandoned with its block
                 for key in [k for k in self._block_traces if rec[k[0]]]:
-                    tid = self._block_traces.pop(key)
+                    tid, _ = self._block_traces.pop(key)
                     if fl.enabled:
                         fl.event(tid, "recycled", "I",
                                  detail=f"slot={key[0]}")
@@ -955,11 +962,11 @@ class SafeKV:
                 for v in np.nonzero(st)[0]:
                     tid = trace[v]
                     if tid:
-                        self._block_traces[(int(s[v]), int(v))] = tid
+                        self._block_traces[(int(s[v]), int(v))] = (tid, t0w)
                         fl.span_at(tid, "seal", t0w, t1w)
             if self._block_traces:
                 # every traced block still in flight rode this round
-                for tid in self._block_traces.values():
+                for tid, _ in self._block_traces.values():
                     fl.span_at(tid, "dag_round", t0w, t1w)
 
         if self.collect_logs:

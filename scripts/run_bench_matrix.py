@@ -64,10 +64,16 @@ def _smoke_cfg(name, cfg):
     elif cfg.mode == "wire_sharded":
         # both A/B arms run the same shrunken schedule; the run's own
         # bit-equality gate (sharded vs unsharded final state) is the
-        # assertion under test, so the smoke only needs enough ops to
-        # cross a few drain/combine/board cycles per shard
+        # assertion under test, plus the SLO-plane gate (smoke_slo_plane
+        # row): the timed window must be 100s of ms, not tens, so the
+        # out-of-band scraper's fixed per-probe CPU (a few ms per
+        # /metrics+/slo pair at period 0.5 s) is diluted to its
+        # steady-state fraction instead of dominating cpu_frac. The
+        # run's wall clock is dominated by fixed setup (imports, both
+        # arms' service spin-up, state comparison), not the window, so
+        # the larger schedule costs ~1 s and buys 2-3x gate margin.
         over = dict(num_objects=16, ops_per_block=64, clients=2,
-                    ops_per_client=4096, frame_ops=512, shards=2)
+                    ops_per_client=262144, frame_ops=512, shards=2)
     elif name == "mixed":
         over = dict(num_nodes=4, num_objects=64, ops_per_block=32,
                     ticks=2)
@@ -125,15 +131,56 @@ def _flight_event_cost_ns() -> float:
     return (time.perf_counter_ns() - t0) / n
 
 
-def _hist_records() -> int:
-    """Total record() calls absorbed by every histogram in the default
-    registry (counter/gauge writes are per-batch, not per-record, so
-    histograms are the telemetry plane's entire per-event hot path)."""
+def _slo_record_cost_ns() -> float:
+    """Measured per-op cost of the SLO ledger's reply-time sampling on
+    the columnar path that absorbs open-loop frame load: observe_batch
+    over frame-sized t0 arrays (one clock read + vectorized deltas +
+    Histogram.record_many). The scalar observe() path exists too
+    (per-item safe acks, deferred reads) but it is ~1.3 us/op and never
+    sees bulk traffic — gating on it would measure the wrong plane.
+
+    Width matters: the call has ~10 us of fixed numpy-dispatch overhead,
+    so per-op cost is width-dependent. Under the smoke's open-loop
+    backlog the service flushes ~32k-op batches (measured median; p10
+    256), so 4096 is already a conservative choice — width 512 would
+    charge the fixed overhead 8x too often and gate on a load shape the
+    loaded run never produces."""
+    import time
+
+    import numpy as np
+
+    from janus_tpu.obs.metrics import Registry
+    from janus_tpu.obs.slo import SloLedger
+
+    led = SloLedger(registry=Registry())
+    width = 4096
+    t0s = np.full(width, time.monotonic_ns() - 50_000, np.int64)
+    iters = 200
+    t0 = time.perf_counter_ns()
+    for _ in range(iters):
+        led.observe_batch("unsafe", t0s)
+    return (time.perf_counter_ns() - t0) / (iters * width)
+
+
+def _hist_records() -> tuple:
+    """(scalar_records, slo_records): record() calls absorbed by every
+    histogram in the default registry (counter/gauge writes are
+    per-batch, not per-record, so histograms are the telemetry plane's
+    entire per-event hot path). SLO-ledger instruments (``slo*`` names)
+    are split out because their samples arrive through record_many's
+    columnar path at ~15 ns/op — billing half a million of them at the
+    scalar record() cost would fail the overhead gate on arithmetic the
+    process never executed."""
     from janus_tpu.obs.metrics import Histogram, get_registry
 
-    return sum(inst.count
-               for inst in get_registry()._instruments.values()
-               if isinstance(inst, Histogram))
+    scalar = slo = 0
+    for name, inst in get_registry()._instruments.items():
+        if isinstance(inst, Histogram):
+            if name.startswith("slo"):
+                slo += inst.count
+            else:
+                scalar += inst.count
+    return scalar, slo
 
 
 def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
@@ -142,21 +189,28 @@ def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
     from janus_tpu.bench.harness import PRESETS, run
 
     cost_ns = _record_cost_ns()
-    print(f"# per-record cost: {cost_ns:.0f} ns", flush=True)
+    slo_cost_ns = _slo_record_cost_ns()
+    print(f"# per-record cost: {cost_ns:.0f} ns "
+          f"(slo batch: {slo_cost_ns:.1f} ns)", flush=True)
     failures = []
+    slo_payload = None  # the wire_sharded preset's row, for the SLO gate
     with open(out_path, "a") as f:
         for name in sorted(PRESETS):
             cfg = _smoke_cfg(name, PRESETS[name])
-            before = _hist_records()
+            b_scalar, b_slo = _hist_records()
             t0 = time.perf_counter()
             res = run(cfg)
             elapsed = time.perf_counter() - t0
-            recs = _hist_records() - before
-            overhead = (recs * cost_ns) / (elapsed * 1e9)
+            a_scalar, a_slo = _hist_records()
+            recs = a_scalar - b_scalar
+            slo_recs = a_slo - b_slo
+            overhead = ((recs * cost_ns + slo_recs * slo_cost_ns)
+                        / (elapsed * 1e9))
             payload = res.to_dict()
             payload["smoke"] = {
                 "elapsed_s": round(elapsed, 3),
                 "hist_records": recs,
+                "slo_records": slo_recs,
                 "record_cost_ns": round(cost_ns, 1),
                 "overhead_pct": round(100 * overhead, 4),
             }
@@ -168,6 +222,8 @@ def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
             f.flush()
             if overhead >= overhead_budget:
                 failures.append((name, overhead))
+            if cfg.mode == "wire_sharded":
+                slo_payload = payload
 
         # flight-recorder overhead row: the light fixed-B preset again
         # (its jit cache is warm from the loop above, so elapsed is
@@ -202,13 +258,72 @@ def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
             failures.append(("flight_overhead(no events)", 1.0))
         elif overhead >= 0.03:
             failures.append(("flight_overhead", overhead))
+
+        # SLO-plane row: gate the out-of-band obs plane on the
+        # wire_sharded preset captured in the loop above (no re-run).
+        # That run scraped /metrics+/slo CONCURRENTLY with the loaded
+        # sharded arm, so its oob numbers are the perturbation evidence:
+        # endpoint+scraper CPU over wall clock, scrape latency at the
+        # deepest backlog, and the ledger's counter reconciliation.
+        # Ledger overhead uses the same analytical form as the rows
+        # above — measured per-observe cost x reply-time samples the
+        # arm actually ledgered, over the arm's own elapsed time.
+        sr = (slo_payload or {}).get("slo_report") or {}
+        oob = (slo_payload or {}).get("oob") or {}
+        arm = (slo_payload or {}).get("arm_sharded") or {}
+        samples = sum(int((sr.get(c) or {}).get("e2e_samples", 0))
+                      for c in ("unsafe", "safe", "stable"))
+        arm_s = float(arm.get("elapsed_s", 0.0))
+        # each shard worker ledgers its own reply flushes CONCURRENTLY,
+        # so the wall-clock the run pays is the max per-shard share
+        # (~samples/shards), not the serialized total
+        shards = max(int(arm.get("shards", 1)), 1)
+        overhead = (samples * slo_cost_ns) / max(shards * arm_s * 1e9, 1.0)
+        payload = {
+            "run": "smoke_slo_plane",
+            "ts": round(time.time(), 1),
+            "config": (slo_payload or {}).get("config", "?"),
+            "slo_report": sr,
+            "oob": oob,
+            "smoke": {
+                "e2e_samples": samples,
+                "slo_record_cost_ns": round(slo_cost_ns, 1),
+                "ledger_overhead_pct": round(100 * overhead, 4),
+            },
+        }
+        line = json.dumps(payload)
+        print(line, flush=True)
+        f.write(line + "\n")
+        f.flush()
+        recon = abs(float(sr.get("replied_vs_total", 0.0)) - 1.0)
+        for gate, bad, frac in (
+                ("slo_plane(no e2e samples)", samples == 0, 1.0),
+                ("slo_plane(ledger overhead)",
+                 overhead >= overhead_budget, overhead),
+                ("slo_plane(no concurrent scrapes)",
+                 int(oob.get("scrapes", 0)) == 0, 1.0),
+                ("slo_plane(scrape errors)",
+                 int(oob.get("scrape_errors", 1)) > 0, 1.0),
+                ("slo_plane(obs cpu_frac)",
+                 float(oob.get("cpu_frac", 1.0)) >= 0.02,
+                 float(oob.get("cpu_frac", 1.0))),
+                ("slo_plane(/health > 250ms under load)",
+                 float(oob.get("health_ms", 1e9)) >= 250.0,
+                 float(oob.get("health_ms", 1e9)) / 1e4),
+                ("slo_plane(/slo > 250ms under load)",
+                 float(oob.get("slo_ms", 1e9)) >= 250.0,
+                 float(oob.get("slo_ms", 1e9)) / 1e4),
+                ("slo_plane(counter reconciliation)",
+                 recon > 0.01, recon)):
+            if bad:
+                failures.append((gate, frac))
     if failures:
         raise AssertionError(
-            "telemetry fast-path overhead budget exceeded: " + ", ".join(
-                f"{n}: {100 * o:.2f}%" for n, o in failures))
-    print(f"# smoke OK: {len(PRESETS)} presets + flight tracing, "
-          f"overhead < {100 * overhead_budget:.0f}% (flight < 3%)",
-          flush=True)
+            "smoke gates failed (telemetry fast path / SLO plane): "
+            + ", ".join(f"{n}: {100 * o:.2f}%" for n, o in failures))
+    print(f"# smoke OK: {len(PRESETS)} presets + flight tracing + SLO "
+          f"plane, overhead < {100 * overhead_budget:.0f}% (flight < 3%);"
+          f" oob scrape cpu_frac {oob.get('cpu_frac', '?')}", flush=True)
 
 
 def main() -> None:

@@ -26,7 +26,11 @@ optional ``ssh``/``workdir`` fields make a proc remote):
       "client_port": 5100, "ssh": "ubuntu@10.0.0.1",
       "workdir": "/home/ubuntu/janus"},
      {"address": "127.0.0.1", "dag_port": 7101, "owned": [2, 3],
-      "client_port": 5101}]}
+      "client_port": 5101, "obs_port": 9101}]}
+
+A proc row's optional ``obs_port`` starts that process's out-of-band
+obs endpoint (/metrics /stats /health /slo /trace); federate them with
+``python -m janus_tpu.obs.httpexp --peer p0=http://host:9100 ...``.
 """
 from __future__ import annotations
 
@@ -110,6 +114,10 @@ def start(cluster_json: str, logdir: str, log_level: str = "info") -> None:
         per["port"] = int(p.get("client_port", 0))
         per["bind_addr"] = p.get("address", "127.0.0.1")
         per["log_level"] = log_level
+        # per-proc out-of-band obs endpoint (obs/httpexp.py); point a
+        # federation front (python -m janus_tpu.obs.httpexp --peer ...)
+        # at these for one merged cluster exposition
+        per["obs_port"] = int(p.get("obs_port", -1))
         cfg_path = os.path.join(logdir, f"proc{i}.json")
         with open(cfg_path, "w") as f:
             json.dump(per, f)

@@ -1,0 +1,448 @@
+"""Out-of-band SLO plane (ISSUE 12): per-op e2e latency ledger, the
+obs HTTP endpoint, and cluster obs federation. The contracts under
+test:
+
+- classify() maps wire op codes to the three consistency classes the
+  paper's latency contracts name (unsafe / safe / stable);
+- SloLedger counts every reply and records latency only for stamped
+  ops (t0_ns <= 0 = old client / v1 frame: counted, never sampled);
+- merge_slo sums bucket VECTORS and recomputes percentiles from the
+  merged counts (percentile-of-percentiles would be wrong), keeping
+  per-node attribution;
+- the service's out-of-band endpoint serves /slo//health//metrics
+  without riding the data plane, and its ledger reconciles exactly
+  with the ops a client actually sent — unsharded and sharded (where
+  /slo additionally carries per-shard nodes);
+- a hand-built v1 batch frame (no t0 header) still applies its ops and
+  counts as unstamped;
+- the merge helpers tolerate degenerate input: empty lists, disjoint
+  key sets (version skew), unknown health statuses, dead federation
+  peers;
+- watchdogs sharing a dump_dir qualify their flight-dump filenames
+  with the configured tag instead of overwriting each other.
+"""
+import json
+import socket
+import struct
+import time
+
+import numpy as np
+
+from janus_tpu.net import JanusClient, JanusConfig, JanusService, TypeConfig
+from janus_tpu.net.client import BatchSender, frame0
+from janus_tpu.net.service import _merge_type_stats
+from janus_tpu.obs import flight
+from janus_tpu.obs.export import render_prometheus
+from janus_tpu.obs.httpexp import (ObsHttpServer, federation_routes,
+                                   merge_prometheus, scrape_json,
+                                   scrape_text)
+from janus_tpu.obs.metrics import (Histogram, Registry, get_registry,
+                                   percentile_from_counts)
+from janus_tpu.obs.slo import OP_CLASSES, SloLedger, classify, merge_slo
+from janus_tpu.obs.watchdog import (HealthWatchdog, WatchdogConfig,
+                                    merge_health)
+
+KEYS = [f"o{k}" for k in range(4)]
+
+
+# -- op classification ----------------------------------------------------
+
+
+def test_classify_covers_the_three_contracts():
+    assert classify("gs", False) == "stable"
+    assert classify("ss", True) == "stable"
+    assert classify("gp", False) == "unsafe"
+    assert classify("sp", False) == "unsafe"
+    assert classify("g", False) == "unsafe"
+    assert classify("i", False) == "unsafe"
+    assert classify("i", True) == "safe"
+    assert classify("s", True) == "safe"
+    assert set(OP_CLASSES) == {"unsafe", "safe", "stable"}
+
+
+# -- ledger unit behavior -------------------------------------------------
+
+
+def test_ledger_unstamped_counts_but_never_samples():
+    led = SloLedger(registry=Registry())
+    led.observe("unsafe", 0)
+    led.observe("unsafe", -5)
+    snap = led.snapshot()
+    assert snap["classes"]["unsafe"]["replied"] == 2
+    assert snap["classes"]["unsafe"]["e2e_samples"] == 0
+    assert snap["replied_total"] == 2
+
+
+def test_ledger_stamped_records_the_delta():
+    led = SloLedger(registry=Registry())
+    led.observe("safe", 1_000, now_ns=5_000)
+    snap = led.snapshot()["classes"]["safe"]
+    assert snap["replied"] == 1
+    assert snap["e2e_samples"] == 1
+    # 4000 ns lands in bucket [2^11, 2^12)
+    assert snap["counts"][12] == 1
+
+
+def test_ledger_batch_mixed_stamped_and_unstamped():
+    led = SloLedger(registry=Registry())
+    t0s = np.array([1_000, 0, 2_000, -1], np.int64)
+    led.observe_batch("unsafe", t0s, now_ns=10_000)
+    snap = led.snapshot()["classes"]["unsafe"]
+    assert snap["replied"] == 4
+    assert snap["e2e_samples"] == 2  # only the two stamped ops
+
+
+def test_ledger_batch_all_stamped_fast_path():
+    led = SloLedger(registry=Registry())
+    led.observe_batch("unsafe", np.full(64, 1_000, np.int64),
+                      now_ns=9_000)
+    snap = led.snapshot()["classes"]["unsafe"]
+    assert snap["replied"] == 64
+    assert snap["e2e_samples"] == 64
+    assert snap["counts"][13] == 64  # 8000 ns -> bucket [2^12, 2^13)
+
+
+def test_ledger_batch_empty_is_a_noop():
+    led = SloLedger(registry=Registry())
+    led.observe_batch("unsafe", np.array([], np.int64))
+    assert led.snapshot()["replied_total"] == 0
+
+
+def test_ledger_scope_lands_in_instrument_names():
+    reg = Registry()
+    SloLedger(scope="_s3", registry=reg).observe("unsafe", 0)
+    assert reg.counter("slo_s3_replied_unsafe_total").value == 1
+
+
+def test_record_many_matches_scalar_record_buckets():
+    """The vectorized path (frexp + bincount) must bucket EXACTLY like
+    the scalar bit_length path — the merged percentiles depend on it."""
+    vals = [0, 1, 2, 3, 7, 8, 1023, 1024, 123_456_789, 2**61, 2**63 - 1]
+    a, b = Histogram("_a"), Histogram("_b")
+    for v in vals:
+        a.record(v)
+    b.record_many(np.array(vals, np.uint64).astype(np.int64))
+    # 2**63 - 1 as int64 stays positive; both paths clip to the top
+    assert a.counts() == b.counts()
+    assert a.count == b.count
+
+
+# -- merge_slo ------------------------------------------------------------
+
+
+def test_merge_slo_sums_buckets_and_recomputes_percentiles():
+    r0, r1 = Registry(), Registry()
+    led0, led1 = SloLedger(registry=r0), SloLedger(registry=r1)
+    # shard 0 is fast (bucket ~2^10 ns), shard 1 slow (~2^20 ns)
+    led0.observe_batch("unsafe", np.full(90, 1_000, np.int64),
+                       now_ns=2_000)
+    led1.observe_batch("unsafe", np.full(10, 1_000, np.int64),
+                       now_ns=1_000_000)
+    led0.offered.add(90)
+    led1.offered.add(10)
+    merged = merge_slo([("s0", led0.snapshot()), ("s1", led1.snapshot())])
+    cl = merged["classes"]["unsafe"]
+    assert cl["replied"] == 100 and cl["e2e_samples"] == 100
+    assert merged["offered"] == 100
+    # p50 must come from the fast mass, p99 from the slow shard's
+    # bucket — averaging per-shard percentiles could produce neither
+    assert cl["e2e_p50_ms"] < 0.01
+    assert cl["e2e_p99_ms"] > 0.5
+    # per-node attribution survives, sans the bulky bucket vectors
+    assert merged["nodes"]["s1"]["classes"]["unsafe"]["replied"] == 10
+    assert "counts" not in merged["nodes"]["s0"]["classes"]["unsafe"]
+
+
+def test_merge_slo_empty_and_missing_classes():
+    merged = merge_slo([])
+    assert merged["replied_total"] == 0
+    assert merged["classes"]["unsafe"]["e2e_p99_ms"] == 0.0
+    # a version-skewed snapshot missing whole sections still folds
+    merged = merge_slo([("x", {"offered": 3})])
+    assert merged["offered"] == 3
+    assert merged["nodes"]["x"]["offered"] == 3
+
+
+# -- stats / health merge degenerates ------------------------------------
+
+
+def test_merge_type_stats_empty_list_is_empty():
+    assert _merge_type_stats([]) == {}
+
+
+def test_merge_type_stats_single_snapshot_is_identity():
+    snap = {"pending_ops": 3, "block_size": 64, "window": 8}
+    assert _merge_type_stats([snap]) == snap
+
+
+def test_merge_type_stats_unions_disjoint_key_sets():
+    # version skew: one shard reports a counter the other doesn't have
+    merged = _merge_type_stats([{"pending_ops": 2},
+                                {"pending_ops": 3, "new_counter": 7}])
+    assert merged["pending_ops"] == 5
+    assert merged["new_counter"] == 7
+
+
+def test_merge_health_empty_is_ok():
+    merged = merge_health([])
+    assert merged["status"] == "OK"
+    assert merged["reasons"] == [] and merged["anomalies"] == 0
+
+
+def test_merge_health_worst_of_with_labeled_reasons():
+    merged = merge_health([
+        ("s0", {"status": "OK", "reasons": [], "anomalies": 0,
+                "dumps": 1, "equivocation": {}}),
+        ("s1", {"status": "STALLED",
+                "reasons": ["commit_stall:pnc -> STALLED: wedged"],
+                "anomalies": 2, "dumps": 3, "equivocation": {2: 5}}),
+    ])
+    assert merged["status"] == "STALLED"
+    assert merged["anomalies"] == 2 and merged["dumps"] == 4
+    assert merged["reasons"] == ["s1: commit_stall:pnc -> STALLED: wedged"]
+    assert merged["equivocation"] == {"s1:2": 5}
+
+
+def test_merge_health_unknown_status_degrades_not_trusted():
+    merged = merge_health([("p0", {"status": "WEIRD", "reasons": []})])
+    assert merged["status"] == "DEGRADED"
+    assert any("unknown status" in r for r in merged["reasons"])
+
+
+# -- federation -----------------------------------------------------------
+
+
+def test_merge_prometheus_splices_node_label():
+    text = merge_prometheus([
+        ("s0", "# HELP x ops\n# TYPE x counter\nx 3\n"),
+        ("s1", "# TYPE x counter\nx{a=\"b\"} 4\n"),
+    ])
+    assert 'x{node="s0"} 3' in text
+    assert 'x{node="s1",a="b"} 4' in text
+    assert text.count("# TYPE x counter") == 1
+
+
+def test_federation_survives_a_dead_peer():
+    reg = Registry()
+    led = SloLedger(registry=reg)
+    led.observe("unsafe", 1_000, now_ns=3_000)
+    wd = HealthWatchdog(registry=reg)
+    peer = ObsHttpServer({
+        "/metrics": lambda: ("text/plain", render_prometheus(reg)),
+        "/slo": lambda: ("application/json", json.dumps(led.snapshot())),
+        "/health": lambda: ("application/json", json.dumps(wd.health())),
+    }, registry=reg)
+    # port 1 refuses connections: a wedged/absent worker host
+    front = ObsHttpServer(federation_routes(
+        [("live", f"http://127.0.0.1:{peer.port}"),
+         ("dead", "http://127.0.0.1:1")], timeout=0.5), registry=reg)
+    base = f"http://127.0.0.1:{front.port}"
+    try:
+        text = scrape_text(base + "/metrics")
+        assert 'obs_peer_up{node="live"} 1' in text
+        assert 'obs_peer_up{node="dead"} 0' in text
+        assert 'slo_replied_unsafe_total{node="live"} 1' in text
+        slo = scrape_json(base + "/slo")
+        assert slo["classes"]["unsafe"]["replied"] == 1
+        assert slo["up"] == {"live": True, "dead": False}
+        health = scrape_json(base + "/health")
+        # the dead peer is a DEGRADED verdict of its own, not a scrape
+        # failure — the cluster verdict escalates instead of erroring
+        assert health["status"] == "DEGRADED"
+        assert any("dead" in r and "unreachable" in r
+                   for r in health["reasons"])
+    finally:
+        front.close()
+        peer.close()
+
+
+def test_obs_endpoint_404_and_handler_errors_keep_serving():
+    reg = Registry()
+
+    def boom():
+        raise RuntimeError("handler bug")
+
+    srv = ObsHttpServer({"/boom": boom,
+                         "/ok": lambda: ("text/plain", "fine\n")},
+                        registry=reg)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        for path, want in (("/nope", 404), ("/boom", 500)):
+            try:
+                scrape_text(base + path)
+                raise AssertionError("expected HTTPError")
+            except Exception as e:
+                assert getattr(e, "code", None) == want, (path, e)
+        assert scrape_text(base + "/ok") == "fine\n"
+        assert reg.counter("obs_http_errors_total").value == 1
+    finally:
+        srv.close()
+
+
+# -- watchdog dump-file tags ---------------------------------------------
+
+
+def test_watchdog_tag_qualifies_dump_filenames(tmp_path):
+    rec = flight.enable()
+    rec.clear()
+    try:
+        wds = [HealthWatchdog(WatchdogConfig(stall_ticks=2,
+                                             dump_dir=str(tmp_path),
+                                             tag=f"s{i}"),
+                              registry=Registry(), recorder=rec)
+               for i in range(2)]
+        for wd in wds:
+            for _ in range(3):
+                wd.observe_commits("pnc", own_commits=5, pending_ops=9)
+        names = sorted(p.name for p in tmp_path.iterdir())
+        # without the tag both would write flight_commit_stall_1.jsonl
+        # and shard 1 would silently overwrite shard 0's evidence
+        assert names == ["flight_commit_stall_s0_1.jsonl",
+                         "flight_commit_stall_s1_1.jsonl"]
+    finally:
+        flight.disable()
+
+
+# -- end-to-end: service obs endpoint + wire t0 ---------------------------
+
+
+def _mk_service(shards: int) -> JanusService:
+    # the service ledgers into the PROCESS-WIDE registry; earlier tests
+    # in this pytest process (shardsvc, harness) left counts behind, so
+    # each e2e test starts from a cleared registry to assert exact
+    # values instead of deltas
+    get_registry().reset()
+    return JanusService(JanusConfig(
+        num_nodes=4, window=8, ops_per_block=16, shards=shards,
+        obs_port=0,
+        types=(TypeConfig("pnc", {"num_keys": 16}),)))
+
+
+def _settle(base: str, want_replied: int, timeout: float = 60.0) -> dict:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        snap = scrape_json(base + "/slo")
+        if snap["replied_total"] >= want_replied:
+            return snap
+        time.sleep(0.05)
+    raise TimeoutError(f"ledger stuck below {want_replied}: {snap}")
+
+
+def test_unsharded_slo_endpoint_reconciles_with_the_client():
+    svc = _mk_service(1)
+    port = svc.start()
+    assert svc.obs_port > 0
+    base = f"http://127.0.0.1:{svc.obs_port}"
+    try:
+        with JanusClient("127.0.0.1", port, timeout=120) as c:
+            for k in KEYS:                              # 4 safe creates
+                c.request("pnc", k, "s", timeout=120)
+            for i in range(8):                          # 8 unsafe updates
+                seq = c.send("pnc", KEYS[i % 4], "i", ["2"])
+            c.wait(seq, timeout=120)
+            c.request("pnc", "o0", "i", ["1"], is_safe=True,
+                      timeout=120)                      # 1 safe update
+            c.request("pnc", "o0", "gp", timeout=120)   # 1 unsafe read
+            c.request("pnc", "o0", "gs", timeout=120)   # 1 stable read
+            snap = _settle(base, want_replied=15)
+        cl = snap["classes"]
+        assert cl["safe"]["replied"] == 5       # 4 creates + 1 safe inc
+        assert cl["unsafe"]["replied"] == 9     # 8 incs + 1 gp
+        assert cl["stable"]["replied"] == 1     # 1 gs
+        assert snap["replied_total"] == 15
+        # every data op was stamped by this client, so every reply
+        # sampled a latency
+        for c_ in OP_CLASSES:
+            assert cl[c_]["e2e_samples"] == cl[c_]["replied"]
+            assert cl[c_]["e2e_p99_ms"] >= cl[c_]["e2e_p50_ms"] > 0
+        # counter ledger: nothing offered was shed, everything offered
+        # was admitted (in-band stats ops from other tests' pattern —
+        # none here — would inflate offered, never replied)
+        assert snap["shed"] == 0
+        assert snap["offered"] == snap["admitted"] >= 15
+        # the out-of-band metrics view carries the same instruments
+        text = scrape_text(base + "/metrics")
+        assert "slo_replied_unsafe_total 9" in text
+        assert "slo_e2e_safe_ns_count 5" in text
+        health = scrape_json(base + "/health")
+        assert health["status"] in ("OK", "DEGRADED", "STALLED")
+    finally:
+        svc.stop()
+
+
+def test_sharded_slo_endpoint_merges_per_shard_nodes():
+    svc = _mk_service(2)
+    port = svc.start()
+    base = f"http://127.0.0.1:{svc.obs_port}"
+    try:
+        with JanusClient("127.0.0.1", port, timeout=120) as c:
+            for k in KEYS:
+                c.request("pnc", k, "s", timeout=120)
+            sender = BatchSender("127.0.0.1", port)
+            idx = [i % 4 for i in range(64)]            # spans both shards
+            sender.send_frame("pnc", KEYS, idx, "i",
+                              p0=[1] * 64)
+            snap = _settle(base, want_replied=68)
+            sender.close()
+            got = int(c.request("pnc", "o0", "gp", timeout=120)["result"])
+            assert got == 16
+        assert set(snap["nodes"]) == {"s0", "s1"}
+        for node in snap["nodes"].values():
+            assert node["offered"] == node["admitted"] > 0
+        assert snap["classes"]["unsafe"]["replied"] == 64
+        # batch-frame t0 rides the v2 header through the shard inbox:
+        # every unsafe op sampled a latency on its owning shard
+        assert snap["classes"]["unsafe"]["e2e_samples"] == 64
+        text = scrape_text(base + "/metrics")
+        assert "slo_s0_replied_unsafe_total" in text
+        assert "slo_s1_replied_unsafe_total" in text
+    finally:
+        svc.stop()
+
+
+def test_v1_batch_frame_applies_but_counts_unstamped():
+    """A pre-t0 client's frame (version byte 1, no <q t0_ns after seq0)
+    must still apply its ops; the ledger counts them replied with zero
+    latency samples."""
+    svc = _mk_service(1)
+    port = svc.start()
+    base = f"http://127.0.0.1:{svc.obs_port}"
+    try:
+        with JanusClient("127.0.0.1", port, timeout=120) as c:
+            c.request("pnc", "o0", "s", timeout=120)
+            s0 = scrape_json(base + "/slo")
+            before = s0["classes"]["unsafe"]
+            tc = b"pnc"
+            head = bytearray([0x00, 1, len(tc)])  # magic, VERSION 1
+            head.extend(tc)
+            head.extend(struct.pack("<I", 1))     # seq0 (no t0 follows)
+            head.extend(struct.pack("<H", 1))
+            kb = b"o0"
+            head.extend(struct.pack("<H", len(kb)))
+            head.extend(kb)
+            m = 8
+            head.extend(struct.pack("<I", m))
+            payload = (bytes(head)
+                       + np.zeros(m, np.int32).tobytes()
+                       + np.full(m, ord("i"), np.uint8).tobytes()
+                       + np.zeros(m, np.uint8).tobytes()
+                       + np.full(m, 3, np.int64).tobytes())
+            s = socket.create_connection(("127.0.0.1", port), timeout=30)
+            s.sendall(frame0(payload))
+            snap = _settle(base, s0["replied_total"] + m)
+            got = int(c.request("pnc", "o0", "gp", timeout=120)["result"])
+            assert got == 24
+            after = snap["classes"]["unsafe"]
+            assert after["replied"] - before["replied"] == m
+            assert after["e2e_samples"] == before["e2e_samples"]
+            s.close()
+    finally:
+        svc.stop()
+
+
+def test_percentile_from_counts_reconciles_with_histogram():
+    h = Histogram("_p")
+    h.record_many(np.full(100, 5_000, np.int64))
+    assert percentile_from_counts(h.counts(), 0.5) == h.percentile(0.5)
+    assert percentile_from_counts([], 0.99) == 0.0
+    assert percentile_from_counts([0, 0], 0.5) == 0.0

@@ -29,7 +29,8 @@ def test_smoke_matrix_all_presets(tmp_path):
     mod.run_smoke(str(out))
 
     rows = [json.loads(line) for line in out.read_text().splitlines()]
-    assert len(rows) == len(PRESETS) + 1  # + the flight-overhead row
+    # + the flight-overhead row + the SLO-plane row
+    assert len(rows) == len(PRESETS) + 2
     by_run = {r["run"]: r for r in rows}
     for name in PRESETS:
         row = by_run[f"smoke_{name}"]
@@ -61,3 +62,16 @@ def test_smoke_matrix_all_presets(tmp_path):
     fl = by_run["smoke_flight_overhead"]["smoke"]
     assert fl["flight_events"] > 0
     assert fl["overhead_pct"] < 3.0
+    # SLO plane (run_smoke already gates these; re-assert the row shape
+    # so the jsonl consumers — fold_slo_reports, dashboards — can rely
+    # on it): out-of-band scrapes ran concurrently with the loaded
+    # sharded arm, stayed sub-250ms, and the ledger reconciled
+    sp = by_run["smoke_slo_plane"]
+    sr, oob = sp["slo_report"], sp["oob"]
+    assert sp["smoke"]["e2e_samples"] > 0
+    assert sp["smoke"]["ledger_overhead_pct"] < 2.0
+    assert sr["unsafe"]["e2e_p99_ms"] >= sr["unsafe"]["e2e_p50_ms"] > 0
+    assert abs(sr["replied_vs_total"] - 1.0) <= 0.01
+    assert oob["scrapes"] > 0 and oob["scrape_errors"] == 0
+    assert oob["health_ms"] < 250.0 and oob["slo_ms"] < 250.0
+    assert oob["cpu_frac"] < 0.02
