@@ -98,6 +98,14 @@ class BenchConfig:
     # op-accumulation threshold handed to JanusConfig.ingest_batch for
     # both wire_sharded arms (0 = device round every service step)
     ingest_batch: int = 0
+    # native zero-GIL shard demux (JanusConfig.native_demux) for the
+    # sharded arms; mode="wire_sharded_native" A/Bs this switch at
+    # EQUAL shard count (native rings vs the Python router)
+    native_demux: bool = True
+    # pin each shard's device state to its own mesh member
+    # (JanusConfig.shard_devices) — the multi-device step-overlap row;
+    # needs >= shards devices (real or XLA virtual) to mean anything
+    shard_devices: bool = False
     seed: int = 0
 
     @classmethod
@@ -1038,12 +1046,15 @@ def _print_slo_reports(rows: List[dict]) -> None:
 
 
 def _wire_sharded_arm(cfg: BenchConfig, shards: int,
-                      schedule: Dict[str, object]) -> Dict[str, object]:
+                      schedule: Dict[str, object],
+                      native: Optional[bool] = None) -> Dict[str, object]:
     """One A/B arm of the sharded-wire benchmark: start a service with
     ``shards`` workers, drive the SAME deterministic op schedule through
     an open-loop BatchSender fleet (columnar batch frames, replies
     drained off-thread and discarded), wait server-side until every op
-    is ingested and drained, then read back every key's value."""
+    is ingested and drained, then read back every key's value.
+    ``native`` overrides cfg.native_demux for this arm (the demux A/B
+    runs both settings at equal shard count)."""
     import threading as _threading
 
     from janus_tpu.net import JanusClient, JanusConfig, JanusService, TypeConfig
@@ -1053,14 +1064,17 @@ def _wire_sharded_arm(cfg: BenchConfig, shards: int,
     keys = [f"o{k}" for k in range(n_keys)]
     from janus_tpu.obs.httpexp import scrape_json
 
+    native = cfg.native_demux if native is None else native
     svc = JanusService(JanusConfig(
         num_nodes=cfg.num_nodes, window=cfg.window,
         ops_per_block=cfg.ops_per_block, max_clients=cfg.clients + 8,
         shards=shards, ingest_batch=cfg.ingest_batch, obs_port=0,
+        native_demux=native, shard_devices=cfg.shard_devices,
         types=(TypeConfig("pnc", {"num_keys": n_keys}),)))
     port = svc.start()
     obs_base = f"http://127.0.0.1:{svc.obs_port}"
-    arm: Dict[str, object] = {"shards": shards}
+    arm: Dict[str, object] = {"shards": shards, "native_demux": native,
+                              "shard_devices": cfg.shard_devices}
     scraper = None
     try:
         pre = JanusClient("127.0.0.1", port, timeout=120)
@@ -1210,18 +1224,10 @@ def _wire_sharded_arm(cfg: BenchConfig, shards: int,
     return arm
 
 
-def run_wire_sharded(cfg: BenchConfig) -> Results:
-    """Offered-load vs goodput A/B over the sharded service plane
-    (ISSUE 9): the SAME deterministic schedule of unsafe pnc updates —
-    columnar batch frames from an open-loop async client fleet — drives
-    an unsharded arm and a ``cfg.shards``-worker arm. The open-loop
-    fleet never waits on replies (BatchSender discards them on a drain
-    thread), so the goodput number measures the server plane, not the
-    driver; the closed-loop native loadgen (run_wire_native) stays as
-    the per-op-frame baseline. Gate: both arms must read back
-    BIT-EQUAL final values on every key, equal to the schedule's
-    predicted sums."""
-    res = Results(cfg)
+def _sharded_schedule(cfg: BenchConfig):
+    """Deterministic open-loop frame schedule shared by every arm of a
+    sharded-wire benchmark: per-client frame lists plus the predicted
+    per-key sums (the bit-equality gate's oracle)."""
     rng = np.random.default_rng(cfg.seed)
     n_keys = min(cfg.num_objects, 64)
     frame_ops = max(64, cfg.frame_ops)
@@ -1245,6 +1251,62 @@ def run_wire_sharded(cfg: BenchConfig) -> Results:
         "warm_idx": warm_idx, "warm_p0": warm_p0,
         "total_ops": cfg.clients * frames_per_client * frame_ops,
     }
+    return schedule, expect
+
+
+def run_wire_sharded_native(cfg: BenchConfig) -> Results:
+    """Demux A/B at EQUAL shard count (ISSUE 17): the same open-loop
+    frame schedule drives a ``cfg.shards``-worker service twice — once
+    with the Python router (the front-end thread decodes, demuxes with
+    numpy, and copies into per-worker inboxes) and once with the native
+    zero-GIL demux (the server routes decoded columns into per-shard
+    rings on its io thread; workers drain their own ring with no Python
+    producer). Gates: bit-equal final state on every key against the
+    schedule's predicted sums, and exact SLO ledger reconciliation
+    (replied == scheduled ops) in BOTH arms — the t0_ns stamp and reply
+    accounting must survive the native path unchanged."""
+    res = Results(cfg)
+    schedule, expect = _sharded_schedule(cfg)
+    shards = max(2, cfg.shards)
+    arm_py = _wire_sharded_arm(cfg, shards, schedule, native=False)
+    arm_nat = _wire_sharded_arm(cfg, shards, schedule, native=True)
+    expect_l = expect.tolist()
+    assert arm_py["finals"] == arm_nat["finals"] == expect_l, (
+        "native-demux/python-router final states diverge:\n"
+        f"  python router: {arm_py['finals'][:8]}...\n"
+        f"  native demux:  {arm_nat['finals'][:8]}...\n"
+        f"  expected:      {expect_l[:8]}...")
+    res.extra["states_bitequal"] = True
+    drop = {"finals", "slo_report", "oob"}
+    res.extra["arm_pyrouter"] = {k: v for k, v in arm_py.items()
+                                 if k not in drop}
+    res.extra["arm_native"] = {k: v for k, v in arm_nat.items()
+                               if k not in drop}
+    res.extra["slo_report"] = arm_nat.get("slo_report")
+    res.extra["slo_report_pyrouter"] = arm_py.get("slo_report")
+    res.extra["oob"] = arm_nat.get("oob")
+    res.extra["demux_speedup"] = round(
+        arm_nat["goodput_ops_per_sec"]
+        / max(arm_py["goodput_ops_per_sec"], 1e-9), 3)
+    res.extra["driver"] = "open-loop BatchSender fleet (columnar frames)"
+    res.total_ops = int(schedule["total_ops"])
+    res.elapsed_s = float(arm_nat["elapsed_s"])
+    return res
+
+
+def run_wire_sharded(cfg: BenchConfig) -> Results:
+    """Offered-load vs goodput A/B over the sharded service plane
+    (ISSUE 9): the SAME deterministic schedule of unsafe pnc updates —
+    columnar batch frames from an open-loop async client fleet — drives
+    an unsharded arm and a ``cfg.shards``-worker arm. The open-loop
+    fleet never waits on replies (BatchSender discards them on a drain
+    thread), so the goodput number measures the server plane, not the
+    driver; the closed-loop native loadgen (run_wire_native) stays as
+    the per-op-frame baseline. Gate: both arms must read back
+    BIT-EQUAL final values on every key, equal to the schedule's
+    predicted sums."""
+    res = Results(cfg)
+    schedule, expect = _sharded_schedule(cfg)
     arm_a = _wire_sharded_arm(cfg, 1, schedule)
     arm_b = _wire_sharded_arm(cfg, max(2, cfg.shards), schedule)
     # the warmup frame runs once per arm, so both arms saw every
@@ -1561,6 +1623,40 @@ PRESETS = {
                                 shards=2, ingest_batch=65536,
                                 ops_ratio=(0.0, 1.0, 0.0),
                                 seed=11),
+    # multi-device step-overlap row (ISSUE 17): same A/B as
+    # wire_sharded but with each shard's device state pinned to its own
+    # mesh member (shard_devices) — run under
+    # XLA_FLAGS=--xla_force_host_platform_device_count=8 for the
+    # virtual-device dryrun; on real multi-chip hosts the pinning is
+    # what lets shard steps overlap instead of queueing on one device
+    "wire_sharded_overlap": BenchConfig(name="wire_pnc_sharded_overlap",
+                                        mode="wire_sharded",
+                                        type_code="pnc", num_nodes=4,
+                                        num_objects=64, ops_per_block=128,
+                                        clients=8, ops_per_client=131072,
+                                        frame_ops=4096, shards=2,
+                                        ingest_batch=65536,
+                                        ops_ratio=(0.0, 1.0, 0.0),
+                                        shard_devices=True, seed=11),
+    # demux A/B at equal shard count (ISSUE 17): Python-router vs
+    # native zero-GIL demux, same schedule — isolates the router
+    # thread's decode+copy cost, which is what capped the round-7
+    # sharded arm below the unsharded one on a single-core host
+    # ops_per_block 128, not 256: a device round's cost scales with
+    # n*B whether lanes are occupied or not, and delta-combining
+    # collapses a 65536-op drain to ~num_objects lanes — at B=256 both
+    # arms were round-bound on dead lanes (measured: B=1024 slowed
+    # both arms ~25%, B=128 left the py arm at its B=256 goodput while
+    # the native arm gained ~15%)
+    "wire_sharded_native": BenchConfig(name="wire_pnc_sharded_native",
+                                       mode="wire_sharded_native",
+                                       type_code="pnc", num_nodes=4,
+                                       num_objects=64, ops_per_block=128,
+                                       clients=8, ops_per_client=131072,
+                                       frame_ops=4096, shards=2,
+                                       ingest_batch=65536,
+                                       ops_ratio=(0.0, 1.0, 0.0),
+                                       seed=11),
     # crash-fault pair (paper §6.2 Fig 11: 8 nodes, 0 vs 2 crashed);
     # window 16 on BOTH so the with/without-crash delta compares like
     # for like (see the byzantine note for why faults need the bigger
@@ -1583,6 +1679,8 @@ def run(cfg: BenchConfig) -> Results:
         return run_wire_native(cfg)
     if cfg.mode == "wire_sharded":
         return run_wire_sharded(cfg)
+    if cfg.mode == "wire_sharded_native":
+        return run_wire_sharded_native(cfg)
     if cfg.mode == "adaptive":
         return run_tensor_adaptive(cfg)
     if cfg.mode == "store_delta":
@@ -1608,7 +1706,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--preset", choices=sorted(PRESETS), help="named preset")
     ap.add_argument("--mode",
                     choices=("tensor", "wire", "wire_native",
-                             "wire_sharded"))
+                             "wire_sharded", "wire_sharded_native"))
     ap.add_argument("--json", action="store_true", help="emit JSON only")
     ap.add_argument("--trace-out", metavar="PATH",
                     help="enable the flight recorder for the run and "

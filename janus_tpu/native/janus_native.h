@@ -88,6 +88,92 @@ int janus_server_poll_batch(JanusServer* s, int cap,
 /* Number of distinct keys seen for a type (key_slot ids are dense). */
 int janus_server_key_count(JanusServer* s, int type_id);
 
+/* ---- native shard demux (zero-GIL router) ----
+ * FNV-1a 64-bit over "type_code/key" mod num_shards — byte-for-byte the
+ * Python runtime/keyspace.py shard_of(), exposed standalone so tests can
+ * assert parity over arbitrary inputs. */
+int janus_shard_of(const char* type_code, const char* key, int num_shards);
+
+/* Enable the demux: data ops decoded from batch frames (and per-op
+ * ClientMessages) route straight into per-shard rings at decode time on
+ * the io thread, keyed by an intern-time shard cache (one producer, N
+ * independent consumers, no Python between them). num_shards <= 1
+ * disables it (every op lands on the single poll_batch queue, the seed
+ * behavior). Re-keys any already-interned slots. Call before serving
+ * traffic — rings are rebuilt and must not race in-flight consumers. */
+int janus_server_set_shards(JanusServer* s, int num_shards);
+
+/* Pin a type to the router queue (control types — stats/metrics/health/
+ * trace — that the front-end answers itself; they are never sharded). */
+int janus_server_pin_type_router(JanusServer* s, int type_id, int pinned);
+
+/* Drain up to `cap` ops from ONE shard's ring; same columns (including
+ * t0_ns, so the per-shard SLO ledgers keep measuring e2e latency) and
+ * semantics as janus_server_poll_batch. Each shard worker calls this
+ * with its own shard id + its own buffers; drains are independent.
+ * Returns count, or -1 for an out-of-range shard. */
+int janus_server_poll_batch_shard(JanusServer* s, int shard, int cap,
+                                  int32_t* type_id, int32_t* key_slot,
+                                  int32_t* op_code, uint8_t* is_safe,
+                                  int64_t* p0, int64_t* p1, int64_t* p2,
+                                  uint64_t* client_tag, int32_t* n_params,
+                                  int64_t* t0_ns);
+
+/* Ring observability: current depth / high-watermark of one shard's
+ * ring (feeds the shard{K}_inbox_hwm gauge), and the router queue's
+ * depth (control ops + undemuxed traffic). Depth and hwm count CLIENT
+ * OPS, including ops absorbed into combined blocks. -1 = bad shard id. */
+long long janus_server_shard_depth(JanusServer* s, int shard);
+long long janus_server_shard_hwm(JanusServer* s, int shard);
+long long janus_server_router_depth(JanusServer* s);
+
+/* ---- native delta-combining (zero-GIL counter pre-aggregation) ----
+ * With the demux on, the io thread can additionally COMBINE a frame's
+ * unsafe commutative counter ops per (op, key) before they ever reach
+ * Python: each batch frame contributes at most one combined block per
+ * shard, carrying the per-(op, key) int64 amount sums plus every
+ * absorbed op's client_tag (the worker still acks per op and feeds the
+ * SLO ledger per op — only the per-op *device lane* identity is gone,
+ * which is exactly what the Python host-side combiner discards too).
+ *
+ * Combining is strictly opt-in, twice over:
+ *   1. per type: janus_server_set_combinable_ops registers which
+ *      single-letter op codes commute ("id" for pnc). Amount semantics
+ *      are the counter lane's: amount = p0, or 1 when p0 == 0; ops
+ *      with amounts outside [0, 2^31) stay per-op (they take the
+ *      Python slow path, same as the host combiner's eligibility).
+ *   2. per (home, key slot): janus_server_arm_combine_slots arms slots
+ *      whose device mapping the owning worker has already resolved —
+ *      an unarmed slot's ops stay per-op, so unknown/uncreated keys
+ *      keep their per-op error semantics. home = homes[conn_id % n]
+ *      as configured by janus_server_set_homes (the Python service's
+ *      client-home rule, mirrored so a frame's ops combine under the
+ *      same home its worker will stage them on).
+ * Safe ops never combine. Ordering note: combined blocks are drained
+ * ahead of the per-op ring; this only ever reorders commuting counter
+ * deltas (armed slots are counter keys, and read-your-writes is
+ * enforced by the worker's per-connection pending counts). */
+int janus_server_set_homes(JanusServer* s, const int32_t* homes, int n);
+int janus_server_set_combinable_ops(JanusServer* s, int type_id,
+                                    const char* op_letters);
+int janus_server_arm_combine_slots(JanusServer* s, int type_id, int home,
+                                   const int32_t* slots, int n);
+
+/* Pop ONE combined block from a shard's block queue into caller
+ * buffers. Returns 1 (block written: n_lanes/n_tags set, lanes in
+ * lane_op/lane_slot/lane_amount, absorbed tags in tags, the frame's
+ * shared send stamp in *t0_ns), 0 (queue empty), -1 (bad shard), or
+ * -2 (buffers too small — required sizes written to n_lanes/n_tags,
+ * block left queued; retry with bigger buffers). */
+int janus_server_poll_combined_shard(JanusServer* s, int shard,
+                                     int max_lanes, int max_tags,
+                                     int32_t* type_id, int32_t* home,
+                                     int64_t* t0_ns, int32_t* lane_op,
+                                     int32_t* lane_slot,
+                                     int64_t* lane_amount,
+                                     int32_t* n_lanes, int32_t* n_tags,
+                                     uint64_t* tags);
+
 /* Send a reply frame for a drained op, protobuf-net shaped like the
  * reference's (ClientMessage.result is a BOOL, field 8; the value or
  * error text rides .response, a string, field 9 —

@@ -36,6 +36,7 @@
 #include <atomic>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -65,8 +66,65 @@ struct Conn {
 struct TypeSpace {
   std::string code;
   int capacity;
+  bool pin_router = false;  // control types: never shard-demuxed
   std::unordered_map<std::string, int32_t> keys;
-  std::vector<std::string> key_names;  // slot -> name (reverse table)
+  std::vector<std::string> key_names;   // slot -> name (reverse table)
+  std::vector<int32_t> key_shards;      // slot -> shard (num_shards > 1)
+  // native delta-combining eligibility: which single-letter op codes
+  // commute (set_combinable_ops), and which (home, slot) combos the
+  // owning worker has armed (arm_combine_slots) — both strictly opt-in,
+  // so unknown keys / unresolved slots keep per-op semantics
+  bool combinable[256] = {};
+  bool any_combinable = false;
+  std::vector<std::vector<uint8_t>> armed;  // [home][slot] -> armed
+};
+
+// FNV-1a 64-bit over "type_code/key" — byte-for-byte the Python
+// runtime/keyspace.py shard_of(), so the native demux and the Python
+// router land every key on the same worker (restart-stable, name-keyed).
+uint64_t fnv1a64_acc(uint64_t h, const char* s, size_t n) {
+  for (size_t i = 0; i < n; i++)
+    h = (h ^ uint64_t(uint8_t(s[i]))) * 0x100000001B3ull;
+  return h;
+}
+
+int shard_of_key(const std::string& type_code, const std::string& key,
+                 int num_shards) {
+  if (num_shards <= 1) return 0;
+  uint64_t h = 0xCBF29CE484222325ull;
+  h = fnv1a64_acc(h, type_code.data(), type_code.size());
+  h = fnv1a64_acc(h, "/", 1);
+  h = fnv1a64_acc(h, key.data(), key.size());
+  return int(h % uint64_t(num_shards));
+}
+
+// One combined block: a single frame's unsafe commutative counter ops
+// for one shard, pre-aggregated per (op, key) on the io thread. The
+// per-(op, key) amount sums ride `lane_*`; every absorbed op's
+// client_tag rides `tags` (the worker still acks and SLO-ledgers per
+// op), and the frame's shared send stamp rides t0_ns.
+struct CombinedBlock {
+  int32_t type_id;
+  int32_t home;
+  int64_t t0_ns;
+  std::vector<int32_t> lane_op, lane_slot;
+  std::vector<int64_t> lane_amount;
+  std::vector<uint64_t> tags;
+};
+
+// One shard's ring: the io thread is the only producer (bulk splice,
+// one lock per frame per shard), the owning Python worker the only
+// consumer — no shared GIL, no cross-shard contention, and none of
+// these locks is ever held together with JanusServer::mu's intern work
+// beyond the splice itself.
+struct ShardRing {
+  std::mutex mu;
+  std::deque<Op> ops;
+  std::deque<CombinedBlock> blocks;  // combined counter blocks (FIFO)
+  // client ops queued, per-op AND absorbed into combined blocks — the
+  // depth/hwm the inbox gauges report must keep counting wire ops
+  long long depth_ops = 0;
+  long long hwm = 0;  // high-watermark of depth_ops
 };
 
 int put_varint(uint64_t v, std::vector<uint8_t>& out) {
@@ -163,8 +221,8 @@ struct JanusServer {
   std::thread io;
   std::atomic<bool> running{false};
 
-  std::mutex mu;  // guards queue, conns, types, interner
-  std::deque<Op> queue;
+  std::mutex mu;  // guards queue, conns, types, interner, num_shards
+  std::deque<Op> queue;  // router queue: control types + undemuxed ops
   std::unordered_map<uint32_t, Conn> conns;
   uint32_t next_conn_id = 1;
   std::vector<TypeSpace> types;
@@ -172,10 +230,57 @@ struct JanusServer {
   std::vector<std::string> value_names;             // id -> param string
   std::atomic<long long> ops_in{0}, replies_out{0};
 
+  // shard demux: 0 = disabled (all ops land on `queue`, the seed
+  // behavior); N > 1 = data ops route straight to rings[shard] at
+  // decode time, off the GIL, keyed by the intern-time shard cache.
+  int num_shards = 0;
+  std::vector<std::unique_ptr<ShardRing>> rings;
+  // client-home rule mirrored from the Python service: a connection's
+  // home node = homes[conn_id % homes.size()] — combining needs it so
+  // a frame's ops aggregate under the home its worker stages them on
+  std::vector<int32_t> homes;
+
   int type_id_of(const std::string& code) {
     for (size_t i = 0; i < types.size(); i++)
       if (types[i].code == code) return int(i);
     return -1;
+  }
+
+  // intern (or look up) a key under mu, maintaining the shard cache;
+  // returns -1 when the keyspace is full (the op drops)
+  int32_t slot_for(TypeSpace& ts, const std::string& key) {
+    auto it = ts.keys.find(key);
+    if (it != ts.keys.end()) return it->second;
+    if (int(ts.keys.size()) >= ts.capacity) return -1;
+    int32_t slot = int32_t(ts.keys.size());
+    ts.keys.emplace(key, slot);
+    ts.key_names.push_back(key);
+    ts.key_shards.push_back(
+        int32_t(shard_of_key(ts.code, key, num_shards)));
+    return slot;
+  }
+
+  // splice a frame's per-shard batches (and its combined block, if the
+  // frame produced one for this shard) into the rings — io thread only,
+  // one lock per frame per shard. Per-op ops and the block go in under
+  // the same lock so depth accounting stays atomic per frame.
+  void push_sharded(std::vector<std::vector<Op>>& per_shard,
+                    std::vector<CombinedBlock>* per_shard_blocks) {
+    for (size_t s = 0; s < per_shard.size(); s++) {
+      CombinedBlock* blk = nullptr;
+      if (per_shard_blocks && !(*per_shard_blocks)[s].tags.empty())
+        blk = &(*per_shard_blocks)[s];
+      if (per_shard[s].empty() && !blk) continue;
+      ShardRing& r = *rings[s];
+      std::lock_guard<std::mutex> lk(r.mu);
+      r.ops.insert(r.ops.end(), per_shard[s].begin(), per_shard[s].end());
+      r.depth_ops += static_cast<long long>(per_shard[s].size());
+      if (blk) {
+        r.depth_ops += static_cast<long long>(blk->tags.size());
+        r.blocks.push_back(std::move(*blk));
+      }
+      if (r.depth_ops > r.hwm) r.hwm = r.depth_ops;
+    }
   }
 
   void io_loop();
@@ -228,12 +333,42 @@ void JanusServer::handle_batch(uint32_t cid, const uint8_t* p, int len) {
   int n_keys = le16(p);
   p += 2;
   std::vector<int32_t> slot_of(size_t(n_keys), -1);
+  std::vector<int32_t> shard_of_slot(size_t(n_keys), 0);
   int appended = 0;
+  // per-shard staging: built lock-free per frame, spliced once per
+  // shard into its ring (the zero-GIL demux — the Python router's
+  // np.isin + fancy-index copy per shard collapses into this loop)
+  std::vector<std::vector<Op>> staged;
+  // per-shard combining accumulators: lane lookup keyed op<<16|kidx
+  // (kidx is a u16 frame-dict index), at most one block per shard
+  std::vector<CombinedBlock> blocks;
+  std::vector<std::unordered_map<uint32_t, size_t>> lane_of;
   {
     std::lock_guard<std::mutex> lk(mu);
     int tid = type_id_of(tc);
     if (tid < 0) return;  // unknown type: drop, as the per-op path does
     TypeSpace& ts = types[size_t(tid)];
+    const bool demux = num_shards > 1 && !ts.pin_router;
+    if (demux) staged.resize(size_t(num_shards));
+    // delta-combining eligibility for this frame: the type has
+    // combinable ops registered AND the client-home rule is known —
+    // then home = homes[cid % n], shared by every op in the frame
+    int32_t home = -1;
+    const std::vector<uint8_t>* armed = nullptr;
+    if (demux && ts.any_combinable && !homes.empty()) {
+      home = homes[size_t(cid) % homes.size()];
+      if (home >= 0 && size_t(home) < ts.armed.size())
+        armed = &ts.armed[size_t(home)];
+    }
+    if (armed) {
+      blocks.resize(size_t(num_shards));
+      lane_of.resize(size_t(num_shards));
+      for (auto& b : blocks) {
+        b.type_id = tid;
+        b.home = home;
+        b.t0_ns = t0_ns;
+      }
+    }
     for (int i = 0; i < n_keys; i++) {
       if (p + 2 > end) return;
       int kl = le16(p);
@@ -241,14 +376,9 @@ void JanusServer::handle_batch(uint32_t cid, const uint8_t* p, int len) {
       if (p + kl > end) return;
       std::string key(reinterpret_cast<const char*>(p), size_t(kl));
       p += kl;
-      auto it = ts.keys.find(key);
-      if (it != ts.keys.end()) {
-        slot_of[size_t(i)] = it->second;
-      } else if (int(ts.keys.size()) < ts.capacity) {
-        slot_of[size_t(i)] = int32_t(ts.keys.size());
-        ts.keys.emplace(key, slot_of[size_t(i)]);
-        ts.key_names.push_back(key);
-      }  // else -1: its ops drop, matching the per-op keyspace-full drop
+      int32_t slot = slot_for(ts, key);
+      slot_of[size_t(i)] = slot;  // -1 drops, matching keyspace-full drop
+      if (slot >= 0) shard_of_slot[size_t(i)] = ts.key_shards[size_t(slot)];
     }
     if (p + 4 > end) return;
     uint32_t m = le32(p);
@@ -264,18 +394,47 @@ void JanusServer::handle_batch(uint32_t cid, const uint8_t* p, int len) {
       if (kidx < 0 || kidx >= n_keys) continue;
       int32_t slot = slot_of[size_t(kidx)];
       if (slot < 0) continue;
+      int64_t p0 = le64s(pp + size_t(i) * 8);
+      uint64_t tag = (uint64_t(cid) << 32) | ((seq0 + i) & 0xffffffff);
+      if (armed && !sf[i] && ts.combinable[oc[i]] &&
+          size_t(slot) < armed->size() && (*armed)[size_t(slot)]) {
+        // counter-lane amount semantics (the Python columnar lane's):
+        // amount = p0, or 1 when p0 == 0; out-of-range amounts stay
+        // per-op, exactly the host combiner's eligibility rule
+        int64_t a = p0 != 0 ? p0 : 1;
+        if (a >= 0 && a < (int64_t(1) << 31)) {
+          size_t sh = size_t(shard_of_slot[size_t(kidx)]);
+          CombinedBlock& b = blocks[sh];
+          uint32_t lk = uint32_t(oc[i]) << 16 | uint32_t(kidx);
+          auto [it, fresh] = lane_of[sh].emplace(lk, b.lane_op.size());
+          if (fresh) {
+            b.lane_op.push_back(int32_t(oc[i]));
+            b.lane_slot.push_back(slot);
+            b.lane_amount.push_back(a);
+          } else {
+            b.lane_amount[it->second] += a;
+          }
+          b.tags.push_back(tag);
+          appended++;
+          continue;  // op absorbed into the combined block
+        }
+      }
       Op op{};
       op.type_id = tid;
       op.key_slot = slot;
       op.op_code = int32_t(oc[i]);
       op.is_safe = sf[i] ? 1 : 0;
       op.n_params = 1;
-      op.p[0] = le64s(pp + size_t(i) * 8);
+      op.p[0] = p0;
       op.t0_ns = t0_ns;
-      op.client_tag = (uint64_t(cid) << 32) | ((seq0 + i) & 0xffffffff);
-      queue.push_back(op);
+      op.client_tag = tag;
+      if (demux)
+        staged[size_t(shard_of_slot[size_t(kidx)])].push_back(op);
+      else
+        queue.push_back(op);
       appended++;
     }
+    if (demux) push_sharded(staged, armed ? &blocks : nullptr);
   }
   if (appended) ops_in.fetch_add(appended, std::memory_order_relaxed);
 }
@@ -291,16 +450,8 @@ void JanusServer::handle_payload(uint32_t cid, const uint8_t* p, int len) {
     int tid = type_id_of(m.type_code);
     if (tid < 0) return;  // unknown type: drop (reference logs + ignores)
     TypeSpace& ts = types[size_t(tid)];
-    auto it = ts.keys.find(m.key);
-    int32_t slot;
-    if (it != ts.keys.end()) {
-      slot = it->second;
-    } else {
-      if (int(ts.keys.size()) >= ts.capacity) return;  // keyspace full
-      slot = int32_t(ts.keys.size());
-      ts.keys.emplace(m.key, slot);
-      ts.key_names.push_back(m.key);
-    }
+    int32_t slot = slot_for(ts, m.key);
+    if (slot < 0) return;  // keyspace full
     op.type_id = tid;
     op.key_slot = slot;
     op.op_code = m.op_code.empty()
@@ -328,7 +479,17 @@ void JanusServer::handle_payload(uint32_t cid, const uint8_t* p, int len) {
         op.p[i] = int64_t(uint64_t(vid) | kInternBit);
       }
     }
-    queue.push_back(op);
+    if (num_shards > 1 && !ts.pin_router) {
+      // slow-path data op: same shard cache as the batch frames, so a
+      // per-op client's ops land on the same worker as its frames
+      ShardRing& r = *rings[size_t(ts.key_shards[size_t(slot)])];
+      std::lock_guard<std::mutex> rk(r.mu);
+      r.ops.push_back(op);
+      r.depth_ops++;
+      if (r.depth_ops > r.hwm) r.hwm = r.depth_ops;
+    } else {
+      queue.push_back(op);
+    }
   }
   ops_in.fetch_add(1, std::memory_order_relaxed);
 }
@@ -489,6 +650,164 @@ extern "C" int janus_server_poll_batch(JanusServer* s, int cap,
     n++;
   }
   return n;
+}
+
+extern "C" int janus_shard_of(const char* type_code, const char* key,
+                              int num_shards) {
+  return shard_of_key(type_code ? type_code : "", key ? key : "",
+                      num_shards);
+}
+
+extern "C" int janus_server_set_shards(JanusServer* s, int num_shards) {
+  if (num_shards < 0 || num_shards > 4096) return -1;
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->num_shards = num_shards;
+  s->rings.clear();
+  for (int i = 0; i < num_shards; i++)
+    s->rings.push_back(std::make_unique<ShardRing>());
+  // re-key any already-interned slots (keys pre-created before the
+  // service flipped the demux on, e.g. the harness's key warmup)
+  for (auto& ts : s->types)
+    for (size_t slot = 0; slot < ts.key_names.size(); slot++)
+      ts.key_shards[slot] =
+          int32_t(shard_of_key(ts.code, ts.key_names[slot], num_shards));
+  return 0;
+}
+
+extern "C" int janus_server_pin_type_router(JanusServer* s, int type_id,
+                                            int pinned) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (type_id < 0 || type_id >= int(s->types.size())) return -1;
+  s->types[size_t(type_id)].pin_router = pinned != 0;
+  return 0;
+}
+
+extern "C" int janus_server_poll_batch_shard(
+    JanusServer* s, int shard, int cap, int32_t* type_id, int32_t* key_slot,
+    int32_t* op_code, uint8_t* is_safe, int64_t* p0, int64_t* p1, int64_t* p2,
+    uint64_t* client_tag, int32_t* n_params, int64_t* t0_ns) {
+  ShardRing* r;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    if (shard < 0 || shard >= int(s->rings.size())) return -1;
+    r = s->rings[size_t(shard)].get();
+  }
+  std::lock_guard<std::mutex> rk(r->mu);
+  int n = 0;
+  while (n < cap && !r->ops.empty()) {
+    const Op& op = r->ops.front();
+    type_id[n] = op.type_id;
+    key_slot[n] = op.key_slot;
+    op_code[n] = op.op_code;
+    is_safe[n] = op.is_safe;
+    p0[n] = op.p[0];
+    p1[n] = op.p[1];
+    p2[n] = op.p[2];
+    client_tag[n] = op.client_tag;
+    n_params[n] = op.n_params;
+    t0_ns[n] = op.t0_ns;
+    r->ops.pop_front();
+    n++;
+  }
+  r->depth_ops -= n;
+  return n;
+}
+
+extern "C" int janus_server_set_homes(JanusServer* s, const int32_t* homes,
+                                      int n) {
+  if (n <= 0 || n > (1 << 20) || !homes) return -1;
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->homes.assign(homes, homes + n);
+  return 0;
+}
+
+extern "C" int janus_server_set_combinable_ops(JanusServer* s, int type_id,
+                                               const char* op_letters) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (type_id < 0 || type_id >= int(s->types.size())) return -1;
+  TypeSpace& ts = s->types[size_t(type_id)];
+  std::memset(ts.combinable, 0, sizeof ts.combinable);
+  ts.any_combinable = false;
+  for (const char* p = op_letters; p && *p; p++) {
+    ts.combinable[uint8_t(*p)] = true;
+    ts.any_combinable = true;
+  }
+  return 0;
+}
+
+extern "C" int janus_server_arm_combine_slots(JanusServer* s, int type_id,
+                                              int home, const int32_t* slots,
+                                              int n) {
+  if (home < 0 || home > 65535 || n < 0) return -1;
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (type_id < 0 || type_id >= int(s->types.size())) return -1;
+  TypeSpace& ts = s->types[size_t(type_id)];
+  if (int(ts.armed.size()) <= home) ts.armed.resize(size_t(home) + 1);
+  std::vector<uint8_t>& av = ts.armed[size_t(home)];
+  for (int i = 0; i < n; i++) {
+    int32_t slot = slots[i];
+    if (slot < 0 || slot >= ts.capacity) continue;  // out of keyspace
+    if (int(av.size()) <= slot) av.resize(size_t(slot) + 1, 0);
+    av[size_t(slot)] = 1;
+  }
+  return 0;
+}
+
+extern "C" int janus_server_poll_combined_shard(
+    JanusServer* s, int shard, int max_lanes, int max_tags, int32_t* type_id,
+    int32_t* home, int64_t* t0_ns, int32_t* lane_op, int32_t* lane_slot,
+    int64_t* lane_amount, int32_t* n_lanes, int32_t* n_tags, uint64_t* tags) {
+  ShardRing* r;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    if (shard < 0 || shard >= int(s->rings.size())) return -1;
+    r = s->rings[size_t(shard)].get();
+  }
+  std::lock_guard<std::mutex> rk(r->mu);
+  if (r->blocks.empty()) return 0;
+  CombinedBlock& b = r->blocks.front();
+  *n_lanes = int32_t(b.lane_op.size());
+  *n_tags = int32_t(b.tags.size());
+  if (int(b.lane_op.size()) > max_lanes || int(b.tags.size()) > max_tags)
+    return -2;  // caller retries with the sizes just written
+  *type_id = b.type_id;
+  *home = b.home;
+  *t0_ns = b.t0_ns;
+  memcpy(lane_op, b.lane_op.data(), b.lane_op.size() * sizeof(int32_t));
+  memcpy(lane_slot, b.lane_slot.data(), b.lane_slot.size() * sizeof(int32_t));
+  memcpy(lane_amount, b.lane_amount.data(),
+         b.lane_amount.size() * sizeof(int64_t));
+  memcpy(tags, b.tags.data(), b.tags.size() * sizeof(uint64_t));
+  r->depth_ops -= static_cast<long long>(b.tags.size());
+  r->blocks.pop_front();
+  return 1;
+}
+
+extern "C" long long janus_server_shard_depth(JanusServer* s, int shard) {
+  ShardRing* r;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    if (shard < 0 || shard >= int(s->rings.size())) return -1;
+    r = s->rings[size_t(shard)].get();
+  }
+  std::lock_guard<std::mutex> rk(r->mu);
+  return r->depth_ops;
+}
+
+extern "C" long long janus_server_shard_hwm(JanusServer* s, int shard) {
+  ShardRing* r;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    if (shard < 0 || shard >= int(s->rings.size())) return -1;
+    r = s->rings[size_t(shard)].get();
+  }
+  std::lock_guard<std::mutex> rk(r->mu);
+  return r->hwm;
+}
+
+extern "C" long long janus_server_router_depth(JanusServer* s) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  return static_cast<long long>(s->queue.size());
 }
 
 extern "C" int janus_server_key_count(JanusServer* s, int type_id) {

@@ -72,6 +72,37 @@ def load() -> ctypes.CDLL:
             u64p, i32p, i64p,
         ]
         lib.janus_server_poll_batch.restype = c.c_int
+        lib.janus_shard_of.argtypes = [c.c_char_p, c.c_char_p, c.c_int]
+        lib.janus_shard_of.restype = c.c_int
+        lib.janus_server_set_shards.argtypes = [c.c_void_p, c.c_int]
+        lib.janus_server_set_shards.restype = c.c_int
+        lib.janus_server_pin_type_router.argtypes = [c.c_void_p, c.c_int,
+                                                     c.c_int]
+        lib.janus_server_pin_type_router.restype = c.c_int
+        lib.janus_server_poll_batch_shard.argtypes = [
+            c.c_void_p, c.c_int, c.c_int, i32p, i32p, i32p, u8p, i64p, i64p,
+            i64p, u64p, i32p, i64p,
+        ]
+        lib.janus_server_poll_batch_shard.restype = c.c_int
+        lib.janus_server_set_homes.argtypes = [c.c_void_p, i32p, c.c_int]
+        lib.janus_server_set_homes.restype = c.c_int
+        lib.janus_server_set_combinable_ops.argtypes = [
+            c.c_void_p, c.c_int, c.c_char_p]
+        lib.janus_server_set_combinable_ops.restype = c.c_int
+        lib.janus_server_arm_combine_slots.argtypes = [
+            c.c_void_p, c.c_int, c.c_int, i32p, c.c_int]
+        lib.janus_server_arm_combine_slots.restype = c.c_int
+        lib.janus_server_poll_combined_shard.argtypes = [
+            c.c_void_p, c.c_int, c.c_int, c.c_int, i32p, i32p, i64p, i32p,
+            i32p, i64p, i32p, i32p, u64p,
+        ]
+        lib.janus_server_poll_combined_shard.restype = c.c_int
+        lib.janus_server_shard_depth.argtypes = [c.c_void_p, c.c_int]
+        lib.janus_server_shard_depth.restype = c.c_longlong
+        lib.janus_server_shard_hwm.argtypes = [c.c_void_p, c.c_int]
+        lib.janus_server_shard_hwm.restype = c.c_longlong
+        lib.janus_server_router_depth.argtypes = [c.c_void_p]
+        lib.janus_server_router_depth.restype = c.c_longlong
         lib.janus_server_key_count.argtypes = [c.c_void_p, c.c_int]
         lib.janus_server_key_count.restype = c.c_int
         lib.janus_server_key_name.argtypes = [c.c_void_p, c.c_int, c.c_int,
@@ -153,6 +184,14 @@ def ecdsa_verify(pub_der: bytes, msg: bytes, sig: bytes) -> bool:
 INTERN_BIT = 1 << 62  # non-numeric params come back interned (server.cc:44)
 
 
+def native_shard_of(type_code: str, key: str, num_shards: int) -> int:
+    """The native FNV-1a shard router, standalone — must agree with
+    ``runtime.keyspace.shard_of`` byte-for-byte (tested over randomized
+    inputs); the demux rings are keyed by the C++ twin of this."""
+    return int(load().janus_shard_of(
+        type_code.encode(), key.encode(), num_shards))
+
+
 class NativeServer:
     """Owning wrapper over the native client-interface server."""
 
@@ -167,6 +206,13 @@ class NativeServer:
         self._started = False
         self._poll_bufs: Optional[dict] = None
         self._poll_cap = 0
+        # per-shard reuse buffers for poll_batch_shard: each shard worker
+        # drains with its OWN arrays (workers poll concurrently from
+        # their threads; sharing poll_batch's buffers would race)
+        self._shard_bufs: dict = {}
+        # per-shard reuse buffers for poll_combined_shard (same per-
+        # consumer ownership rule; returned blocks are copied out)
+        self._comb_bufs: dict = {}
 
     def start(self) -> int:
         rc = self._lib.janus_server_start(self._h)
@@ -223,6 +269,163 @@ class NativeServer:
             ptr(b["n_params"], c.c_int32), ptr(b["t0_ns"], c.c_int64),
         )
         return {f: v[:n] for f, v in b.items()}
+
+    def set_shards(self, num_shards: int) -> None:
+        """Enable the native shard demux: decoded data ops route into
+        per-shard rings at decode time on the io thread, keyed by an
+        intern-time FNV-1a shard cache mirroring ``keyspace.shard_of``.
+        Call before serving traffic; ``num_shards <= 1`` disables."""
+        rc = self._lib.janus_server_set_shards(self._h, num_shards)
+        if rc != 0:
+            raise RuntimeError(f"janus_server_set_shards failed ({rc})")
+        self._shard_bufs = {}
+        self._comb_bufs = {}
+
+    def pin_type_router(self, type_id: int, pinned: bool = True) -> None:
+        """Pin a type's ops to the router queue (control types the
+        front-end answers itself — never shard-demuxed)."""
+        rc = self._lib.janus_server_pin_type_router(
+            self._h, type_id, 1 if pinned else 0)
+        if rc != 0:
+            raise RuntimeError(f"janus_server_pin_type_router failed ({rc})")
+
+    def poll_batch_shard(self, shard: int, cap: int):
+        """Drain up to ``cap`` ops from ONE shard's native ring; same
+        columns (and same reuse-buffer caveat) as ``poll_batch``, but
+        the buffers are per-shard so each worker thread drains its own
+        ring without touching any other consumer's arrays."""
+        c = ctypes
+        entry = self._shard_bufs.get(shard)
+        if entry is None or cap > entry[1]:
+            bufs = {
+                "type_id": np.empty(cap, np.int32),
+                "key_slot": np.empty(cap, np.int32),
+                "op_code": np.empty(cap, np.int32),
+                "is_safe": np.empty(cap, np.uint8),
+                "p0": np.empty(cap, np.int64),
+                "p1": np.empty(cap, np.int64),
+                "p2": np.empty(cap, np.int64),
+                "client_tag": np.empty(cap, np.uint64),
+                "n_params": np.empty(cap, np.int32),
+                "t0_ns": np.empty(cap, np.int64),
+            }
+            entry = (bufs, cap)
+            self._shard_bufs[shard] = entry
+        b = entry[0]
+
+        def ptr(a, t):
+            return a.ctypes.data_as(c.POINTER(t))
+
+        n = self._lib.janus_server_poll_batch_shard(
+            self._h, shard, cap,
+            ptr(b["type_id"], c.c_int32), ptr(b["key_slot"], c.c_int32),
+            ptr(b["op_code"], c.c_int32), ptr(b["is_safe"], c.c_uint8),
+            ptr(b["p0"], c.c_int64), ptr(b["p1"], c.c_int64),
+            ptr(b["p2"], c.c_int64), ptr(b["client_tag"], c.c_uint64),
+            ptr(b["n_params"], c.c_int32), ptr(b["t0_ns"], c.c_int64),
+        )
+        if n < 0:
+            raise RuntimeError(f"poll_batch_shard: bad shard {shard}")
+        return {f: v[:n] for f, v in b.items()}
+
+    def set_homes(self, homes) -> None:
+        """Mirror the Python service's client-home rule into the native
+        layer (home = homes[conn_id % n]); required before any frame
+        can delta-combine, so a frame's ops aggregate under the same
+        home its worker will stage them on."""
+        h = np.ascontiguousarray(homes, np.int32)
+        rc = self._lib.janus_server_set_homes(
+            self._h, h.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(h))
+        if rc != 0:
+            raise RuntimeError(f"janus_server_set_homes failed ({rc})")
+
+    def set_combinable_ops(self, type_id: int, op_letters: str) -> None:
+        """Register which single-letter op codes of a type commute (for
+        pnc: "id") — the per-type half of the combining opt-in."""
+        rc = self._lib.janus_server_set_combinable_ops(
+            self._h, type_id, op_letters.encode())
+        if rc != 0:
+            raise RuntimeError(
+                f"janus_server_set_combinable_ops failed ({rc})")
+
+    def arm_combine_slots(self, type_id: int, home: int, slots) -> None:
+        """Arm (home, key slot) combos whose device mapping the owning
+        worker has resolved — the per-slot half of the combining opt-in.
+        Unarmed slots keep exact per-op semantics."""
+        sl = np.ascontiguousarray(slots, np.int32).ravel()
+        rc = self._lib.janus_server_arm_combine_slots(
+            self._h, type_id, home,
+            sl.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(sl))
+        if rc != 0:
+            raise RuntimeError(
+                f"janus_server_arm_combine_slots failed ({rc})")
+
+    def poll_combined_shard(self, shard: int):
+        """Pop ONE combined counter block from a shard's block queue.
+        Returns None when the queue is empty, else a dict with type_id,
+        home, t0_ns (python ints), lane_op/lane_slot (int32), lane_amount
+        (int64) and tags (uint64) — OWNED copies, safe to hold across
+        further polls. Grows the reuse buffers on -2 and retries."""
+        c = ctypes
+        entry = self._comb_bufs.get(shard)
+        if entry is None:
+            entry = {
+                "lane_op": np.empty(4096, np.int32),
+                "lane_slot": np.empty(4096, np.int32),
+                "lane_amount": np.empty(4096, np.int64),
+                "tags": np.empty(65536, np.uint64),
+            }
+            self._comb_bufs[shard] = entry
+        tid_o, home_o = c.c_int32(0), c.c_int32(0)
+        t0 = c.c_int64(0)
+        nl = c.c_int32(0)
+        nt = c.c_int32(0)
+
+        def ptr(a, t):
+            return a.ctypes.data_as(c.POINTER(t))
+
+        while True:
+            rc = self._lib.janus_server_poll_combined_shard(
+                self._h, shard,
+                len(entry["lane_op"]), len(entry["tags"]),
+                c.byref(tid_o), c.byref(home_o), c.byref(t0),
+                ptr(entry["lane_op"], c.c_int32),
+                ptr(entry["lane_slot"], c.c_int32),
+                ptr(entry["lane_amount"], c.c_int64),
+                c.byref(nl), c.byref(nt),
+                ptr(entry["tags"], c.c_uint64))
+            if rc == 0:
+                return None
+            if rc == 1:
+                n_lanes, n_tags = int(nl.value), int(nt.value)
+                return {
+                    "type_id": int(tid_o.value), "home": int(home_o.value),
+                    "t0_ns": int(t0.value),
+                    "lane_op": entry["lane_op"][:n_lanes].copy(),
+                    "lane_slot": entry["lane_slot"][:n_lanes].copy(),
+                    "lane_amount": entry["lane_amount"][:n_lanes].copy(),
+                    "tags": entry["tags"][:n_tags].copy(),
+                }
+            if rc == -2:  # buffers too small: required sizes in nl/nt
+                for f, need in (("lane_op", nl.value), ("lane_slot",
+                                nl.value), ("lane_amount", nl.value),
+                                ("tags", nt.value)):
+                    if len(entry[f]) < need:
+                        entry[f] = np.empty(
+                            max(int(need), 2 * len(entry[f])),
+                            entry[f].dtype)
+                continue
+            raise RuntimeError(f"poll_combined_shard: bad shard {shard}")
+
+    def shard_depth(self, shard: int) -> int:
+        return int(self._lib.janus_server_shard_depth(self._h, shard))
+
+    def shard_hwm(self, shard: int) -> int:
+        return int(self._lib.janus_server_shard_hwm(self._h, shard))
+
+    def router_depth(self) -> int:
+        return int(self._lib.janus_server_router_depth(self._h))
 
     def key_count(self, type_id: int) -> int:
         return self._lib.janus_server_key_count(self._h, type_id)

@@ -110,6 +110,18 @@ class JanusConfig:
     # one mesh member per shard, so shard programs run on distinct
     # devices and their steps overlap (parallel/mesh.py)
     shard_devices: bool = False
+    # native zero-GIL shard demux: the server routes decoded batch-frame
+    # columns (and per-op data ops) into per-shard native rings at
+    # decode time on its io thread, keyed by the same FNV-1a
+    # shard_of(type_code, key) as the Python router; each worker drains
+    # only its own ring (janus_server_poll_batch_shard). False = the
+    # Python router fallback: the front-end polls the wire, demuxes
+    # with numpy, and copies columns into each worker's _ShardInbox.
+    native_demux: bool = True
+    # _ShardInbox / native-ring soft bound: observations of a depth past
+    # this bump shard{K}_inbox_overflow_total — the sensor admission
+    # control needs (nothing is shed yet; the slo shed counter stays 0)
+    inbox_soft_cap: int = 1 << 20
     # op accumulation: defer the device round while ONLY ingest-acked
     # update work is pending (no reads, no safe acks or creates in
     # flight) until this many client ops accumulate or ingest_wait_ms
@@ -172,6 +184,8 @@ class JanusConfig:
             max_clients=int(raw.get("max_clients", 64)),
             shards=int(raw.get("shards", 1)),
             shard_devices=bool(raw.get("shard_devices", False)),
+            native_demux=bool(raw.get("native_demux", True)),
+            inbox_soft_cap=int(raw.get("inbox_soft_cap", 1 << 20)),
             ingest_batch=int(raw.get("ingest_batch", 0)),
             ingest_wait_ms=float(raw.get("ingest_wait_ms", 10.0)),
             watchdog_stall_ticks=int(raw.get("watchdog_stall_ticks", 200)),
@@ -463,17 +477,30 @@ class _ShardInbox:
     chunks (already COPIED out of the native poll buffers — those are
     reused next poll), the worker drains everything at its next step.
     One lock, two list swaps; depth is kept incrementally so the
-    queue-depth gauge never walks the chunks."""
+    queue-depth gauge never walks the chunks.
 
-    def __init__(self):
+    ``hwm``/``overflows`` are growth sensors: the high-watermark feeds
+    the shard{K}_inbox_hwm gauge, and every put that lands past
+    ``soft_cap`` bumps ``overflows`` (-> shard{K}_inbox_overflow_total).
+    Nothing is shed — the cap is the admission-control tripwire, not a
+    drop policy, so the slo ``shed`` counter stays structurally zero."""
+
+    def __init__(self, soft_cap: int = 1 << 20):
         self._lock = threading.Lock()
         self._chunks: List[Dict[str, np.ndarray]] = []
         self.depth = 0  # ops currently queued (racy read is fine)
+        self.soft_cap = soft_cap
+        self.hwm = 0  # deepest the inbox has ever been
+        self.overflows = 0  # puts that pushed depth past soft_cap
 
     def put(self, cols: Dict[str, np.ndarray]) -> None:
         with self._lock:
             self._chunks.append(cols)
             self.depth += len(cols["client_tag"])
+            if self.depth > self.hwm:
+                self.hwm = self.depth
+            if self.depth > self.soft_cap:
+                self.overflows += 1
 
     def drain(self) -> Dict[str, np.ndarray]:
         with self._lock:
@@ -653,6 +680,15 @@ class JanusService:
         # wall clock of the last completed device round (op-accumulation
         # wait budget measures from here)
         self._last_round_t = time.perf_counter()
+        # worker drains its native ring directly (zero-GIL demux) when
+        # the demux is on; the _ShardInbox stays as the fallback lane
+        # for anything the front still routes (its offered counts were
+        # bumped at route time, so drain accounting must not re-count)
+        self._native_ring = (self._shard_id is not None
+                             and cfg.shards > 1 and cfg.native_demux)
+        self._overflow_seen = 0  # overflow events already exported
+        self._ring_overflows = 0  # native-ring depth-past-cap sightings
+        self._ring_hold_t0 = None  # drain hold-off window start
         if self._inbox is not None:
             self._shard_m = obs_metrics.shard_instruments(self._shard_id)
             if cfg.shard_devices:
@@ -678,7 +714,27 @@ class JanusService:
                  self._trace_tid], np.int32)
             for k in range(cfg.shards):
                 self.workers.append(JanusService(
-                    cfg, _server=self.server, _shard=(k, _ShardInbox())))
+                    cfg, _server=self.server,
+                    _shard=(k, _ShardInbox(cfg.inbox_soft_cap))))
+            if cfg.native_demux:
+                # flip the server into demux mode BEFORE any traffic:
+                # decoded ops now land in per-shard native rings on the
+                # io thread (re-keying any slots interned so far), and
+                # control types stay pinned to the router queue so
+                # _route_step still answers stats/metrics/health/trace
+                self.server.set_shards(cfg.shards)
+                for t in self._ctrl_tids.tolist():
+                    self.server.pin_type_router(int(t), True)
+                # native delta-combining opt-in, per-type half: mirror
+                # the client-home rule below Python and register the
+                # commuting counter ops ("id" for pnc). The per-slot
+                # half is armed by each worker as it resolves (home,
+                # key) -> device slot, so unknown keys keep exact
+                # per-op semantics until their create commits.
+                self.server.set_homes(self._homes)
+                for tid, tcfg in zip(self._tid_order, cfg.types):
+                    if tcfg.type_code == "pnc":
+                        self.server.set_combinable_ops(tid, "id")
 
     # -- lifecycle -------------------------------------------------------
 
@@ -863,32 +919,104 @@ class JanusService:
         # a B=8192 geometry left blocks 1/8 full while paying the full
         # device-step cost (the cap, not the device, set the ceiling)
         t_ingest = time.perf_counter_ns()
+        offer_n = 0  # ops whose offered count is owed at this drain
+        blocks: List[dict] = []  # native combined counter blocks
         if self._inbox is not None:
-            # shard worker: ops arrive pre-routed from the front-end
+            # shard worker: ops arrive pre-routed — from this shard's
+            # native ring (zero-GIL demux) and/or the Python-routed
+            # inbox (the fallback lane; also strays under native demux)
             now_pc = time.perf_counter()
             if self._last_step_end is not None:
                 self._shard_m["step_lag"].set(
                     round(1e3 * (now_pc - self._last_step_end), 3))
-            self._shard_m["queue_depth"].set(self._inbox.depth)
-            polled = self._inbox.drain()
+            if self._native_ring:
+                ring_depth = self.server.shard_depth(self._shard_id)
+                self._shard_m["queue_depth"].set(
+                    ring_depth + self._inbox.depth)
+                self._shard_m["inbox_hwm"].max(max(
+                    self.server.shard_hwm(self._shard_id),
+                    self._inbox.hwm))
+                if ring_depth > self.cfg.inbox_soft_cap:
+                    self._ring_overflows += 1
+                cap = min(65536, max(_POLL_FLOOR,
+                                     n * self.cfg.ops_per_block))
+                # drain hold-off — the poll-level twin of the op
+                # accumulation below: while the io thread is still
+                # ringing a burst, a drain now would take a sliver and
+                # pay _ingest_columnar's fixed numpy-dispatch cost as
+                # dearly as a full poll would (and, on a shared core,
+                # steal GIL time from the other shards' drains). Defer
+                # until a full poll is ringed or the wait budget
+                # expires; small backlogs (below the floor) drain
+                # immediately so light-load latency is untouched.
+                if (self.cfg.ingest_batch > 0
+                        and max(self.cfg.ops_per_block, cap // 16)
+                            <= ring_depth < cap
+                        and not self._inbox.depth
+                        and not self._waiting
+                        and not self._deferred_reads
+                        and all(not rt.ack_map and not rt.create_tags
+                                for rt in self.types.values())):
+                    if self._ring_hold_t0 is None:
+                        self._ring_hold_t0 = now_pc
+                    if (now_pc - self._ring_hold_t0
+                            < self.cfg.ingest_wait_ms * 1e-3):
+                        self._last_step_end = time.perf_counter()
+                        return False  # pump naps; the core goes to io
+                self._ring_hold_t0 = None
+                polled = self.server.poll_batch_shard(
+                    self._shard_id, cap)
+                # the ring drain IS the offer for these ops (the front
+                # never saw them); inbox strays were offered at route
+                offer_n = len(polled["client_tag"])
+                # drain combined counter blocks AFTER the per-op ring:
+                # any block the io thread pushed before a ring op we
+                # just drained is necessarily caught here, so the
+                # read-your-writes pending counts of absorbed ops are
+                # always registered before this step answers reads
+                blk = self.server.poll_combined_shard(self._shard_id)
+                while blk is not None:
+                    blocks.append(blk)
+                    blk = self.server.poll_combined_shard(self._shard_id)
+                if self._inbox.depth:
+                    extra = self._inbox.drain()
+                    if len(extra["client_tag"]):
+                        polled = {f: np.concatenate([polled[f], extra[f]])
+                                  for f, _ in _POLL_FIELDS}
+            else:
+                self._shard_m["queue_depth"].set(self._inbox.depth)
+                self._shard_m["inbox_hwm"].max(self._inbox.hwm)
+                polled = self._inbox.drain()
+            ovf = self._inbox.overflows + self._ring_overflows
+            if ovf > self._overflow_seen:
+                self._shard_m["inbox_overflow"].add(
+                    ovf - self._overflow_seen)
+                self._overflow_seen = ovf
         else:
             polled = self.server.poll_batch(
                 min(65536, max(_POLL_FLOOR,
                                n * self.cfg.ops_per_block)))
+            offer_n = len(polled["client_tag"])
         count = len(polled["client_tag"])
         slow_idx = None
         reads: List[dict] = []
         if count:
             self.perf.add(count)
-            # SLO plane: admitted = ops this step loop drained; on the
-            # unsharded service the poll is also the offer (the router
-            # bumps per-worker offered at route time otherwise)
+            # SLO plane: admitted = ops this step loop drained; offered
+            # is owed here for ops whose drain is their first sighting
+            # (unsharded poll, native ring) — the router bumps offered
+            # at route time for inbox traffic
             self.slo.admitted.add(count)
-            if self._inbox is None:
-                self.slo.offered.add(count)
+            if offer_n:
+                self.slo.offered.add(offer_n)
             if self._shard_m is not None:
                 self._shard_m["ops_total"].add(count)
             slow_idx = self._ingest_columnar(polled, reads)
+        for j, blk in enumerate(blocks):
+            # combined blocks stage AFTER this poll's ring ops (their
+            # lanes are commuting counter deltas, so intra-step order
+            # against per-op lanes cannot change any sum)
+            self._ingest_combined(blk, count + j)
         waiting = self._waiting
         self._waiting = []
         for it in waiting:
@@ -958,7 +1086,7 @@ class JanusService:
                             continue
                     q.append(e)
             self._stage.clear()
-        if count:
+        if count or blocks:
             # measured ingest leg: wire poll -> staged on runtime queues
             self._h_ingest.record(time.perf_counter_ns() - t_ingest)
 
@@ -979,11 +1107,11 @@ class JanusService:
                     < self.cfg.ingest_batch):
             if self._shard_m is not None:
                 self._last_step_end = time.perf_counter()
-            return count > 0
+            return count > 0 or bool(blocks)
 
         # ride pending work on each node's next block, advance one round,
         # materialize committed key creates, send deferred safe acks
-        busy = count > 0 or bool(self._waiting)
+        busy = count > 0 or bool(blocks) or bool(self._waiting)
         for rt in self.types.values():
             busy |= self._step_type(rt)
             self._materialize_creates(rt)
@@ -1098,6 +1226,7 @@ class JanusService:
             # resolved once: later updates for this (home, key) take the
             # columnar lane
             rt.fast_slot[home, raw] = slot
+            self._arm_native_combine(it["tid"], home, (raw,))
         if rt.spec.type_code == "rga" and self._conn_has_pending(tag >> 32):
             # position-based ops resolve their anchor against the home
             # view's CURRENT order — earlier pipelined edits from this
@@ -1180,13 +1309,17 @@ class JanusService:
                 combos = {(int(h), int(r)) for h, r in
                           zip(home[idxs[mi]], sr[mi])}
                 hit = False
+                armed: Dict[int, List[int]] = {}
                 for h, raw in combos:
                     key = self._key_str(rt, t, raw)
                     if key in rt.known_keys:
                         slot = rt.rks.slot(h, key)
                         if slot is not None:
                             rt.fast_slot[h, raw] = slot
+                            armed.setdefault(h, []).append(raw)
                             hit = True
+                for h, raws in armed.items():
+                    self._arm_native_combine(t, h, raws)
                 if hit:
                     rs = np.where(
                         s_ok,
@@ -1314,6 +1447,99 @@ class JanusService:
         out["pend"] = np.unique(conns, return_counts=True)
         out["nops"] = len(safe)
         return out
+
+    def _arm_native_combine(self, tid: int, home: int, raws) -> None:
+        """Arm (home, native slot) combos for io-thread delta-combining,
+        called at the moment the worker resolves them into fast_slot —
+        from then on the native layer may pre-aggregate unsafe counter
+        ops for these combos before they ever reach Python. Counter
+        types only: combining discards per-op device-lane identity,
+        which is exactly (and only) what the pnc host combiner does."""
+        if self._native_ring and self._fast_kind.get(tid) == "pnc":
+            self.server.arm_combine_slots(tid, int(home), list(raws))
+
+    def _ingest_combined(self, blk: dict, pos: int) -> None:
+        """Stage one NATIVE combined counter block (io-thread built,
+        poll_combined_shard drained): the zero-GIL twin of
+        _combine_pnc_chunk's output. Per-op work here is one bulk ack
+        append, one vectorized SLO sample, and one np.unique over conns
+        — the per-lane numpy walk the Python-router arm pays per op
+        never runs. Absorbed ops were already counted into ops_in by
+        the io thread; they are offered/admitted here, at first Python
+        sighting, like any ring drain."""
+        tid = blk["type_id"]
+        rt = self.types.get(tid)
+        tags = blk["tags"]
+        n = len(tags)
+        if rt is None or n == 0:
+            return
+        home = blk["home"]
+        self.perf.add(n)
+        self.slo.offered.add(n)
+        self.slo.admitted.add(n)
+        if self._shard_m is not None:
+            self._shard_m["ops_total"].add(n)
+        # read-your-writes: absorbed ops count per connection until
+        # their chunk boards a block (pend consumed at block-accept)
+        conns = (tags >> np.uint64(32)).astype(np.int64)
+        uconn, ucnt = np.unique(conns, return_counts=True)
+        for cn, k in zip(uconn.tolist(), ucnt.tolist()):
+            self._conn_pending[cn] = self._conn_pending.get(cn, 0) + k
+        # immediate acks + e2e SLO, per ORIGINAL op (the frame's shared
+        # t0 stamp fans out to every absorbed op; 0 = unstamped v1)
+        self._ack_bulk.append(tags)
+        t0 = blk["t0_ns"]
+        self.slo.observe_batch("unsafe", np.full(n, t0, np.int64))
+        # native slots -> device lanes; armed combos are resolved by
+        # construction (armed only after fast_slot was written)
+        o = self._fast_ops[tid][blk["lane_op"]]
+        ds = rt.fast_slot[home, blk["lane_slot"]]
+        if int(ds.min(initial=0)) < 0 or int(o.min(initial=0)) < 0:
+            raise RuntimeError(
+                f"native combined block carries unarmed lanes "
+                f"(tid={tid} home={home})")
+        amt = blk["lane_amount"]
+        cap = 2**31 - 1  # device lanes are int32; split larger sums
+        if bool((amt > cap).any()):
+            o_l, s_l, a_l = [], [], []
+            for opc, sl, tot in zip(o.tolist(), ds.tolist(), amt.tolist()):
+                while True:
+                    part = min(tot, cap)
+                    o_l.append(opc)
+                    s_l.append(sl)
+                    a_l.append(part)
+                    tot -= part
+                    if tot <= 0:
+                        break
+            o = np.asarray(o_l, np.int32)
+            ds = np.asarray(s_l, np.int32)
+            a0 = np.asarray(a_l, np.int32)
+        else:
+            a0 = amt.astype(np.int32)
+        # stage in <= limit-lane chunks so each boards an empty block
+        # atomically; the aggregate pend/nops bookkeeping rides the
+        # LAST chunk (conn pending counts release once all lanes sit
+        # in a block — conservative, never early)
+        limit = max(1, min(self.cfg.block_floor, self.cfg.ops_per_block))
+        lst = self._stage.setdefault((tid, int(home)), [])
+        for j, lo in enumerate(range(0, len(o), limit)):
+            sl = slice(lo, lo + limit)
+            nl = len(o[sl])
+            last = lo + limit >= len(o)
+            chunk = {
+                "op": np.ascontiguousarray(o[sl], np.int32),
+                "key": np.ascontiguousarray(ds[sl], np.int32),
+                "a0": a0[sl],
+                "a1": np.zeros(nl, np.int32),
+                "a2": np.zeros(nl, np.int32),
+                "safe": np.zeros(nl, bool),
+                "tag": np.full(nl, tags[0], np.uint64),
+                "t0": np.full(nl, t0, np.int64),
+                "pend": ((uconn, ucnt) if last else
+                         (uconn[:0], ucnt[:0])),
+                "nops": n if last else 0,
+            }
+            lst.append((pos + j, ("chunk", chunk)))
 
     def _ingest_residual(self, polled, fast: np.ndarray,
                          reads: List[dict]) -> np.ndarray:
@@ -1874,7 +2100,7 @@ class JanusService:
             "step_ms_p50": round(float(np.percentile(steps, 50)), 2),
             "step_ms_p99": round(float(np.percentile(steps, 99)), 2),
             "shard_count": self.cfg.shards,
-            "inbox_depth": sum(w._inbox.depth for w in self.workers),
+            "inbox_depth": sum(w._inbox_depth() for w in self.workers),
             "types": {tc: _merge_type_stats(snaps)
                       for tc, snaps in type_snaps.items()},
             "health": self._health_merged(),
@@ -1888,6 +2114,15 @@ class JanusService:
         (obs.watchdog.merge_health — the same fold federation uses)."""
         return merge_health([(f"s{k}", w.watchdog.health())
                              for k, w in enumerate(self.workers)])
+
+    def _inbox_depth(self) -> int:
+        """Ops routed to this worker but not yet drained: Python inbox
+        plus (under native demux) this shard's native ring. Completion
+        checks poll this — pending_ops only sees ops past ingest."""
+        d = self._inbox.depth if self._inbox is not None else 0
+        if self._native_ring:
+            d += max(0, int(self.server.shard_depth(self._shard_id)))
+        return d
 
     # -- in-band telemetry ------------------------------------------------
 
@@ -1915,11 +2150,10 @@ class JanusService:
                 }
                 for rt in self.types.values()
             },
-            # ops routed to this worker but not yet drained from its
-            # inbox (always 0 off the shard path): completion checks
-            # need it — pending_ops only sees ops past ingest
-            "inbox_depth": (self._inbox.depth
-                            if self._inbox is not None else 0),
+            # ops routed to this worker but not yet drained (inbox +
+            # native ring; always 0 off the shard path): completion
+            # checks need it — pending_ops only sees ops past ingest
+            "inbox_depth": self._inbox_depth(),
             # watchdog verdict (OK / DEGRADED / STALLED + reasons; the
             # standalone `health` command answers with just this)
             "health": self.watchdog.health(),
@@ -2019,7 +2253,8 @@ class JanusService:
         if self._front:
             return obs_slo.merge_slo(
                 [(f"s{k}", w.slo.snapshot())
-                 for k, w in enumerate(self.workers)])
+                 for k, w in enumerate(self.workers)],
+                scope=f"front_p{self.cfg.proc_index}")
         return self.slo.snapshot()
 
     def _health_oob(self) -> dict:
@@ -2045,7 +2280,7 @@ class JanusService:
         }
         if self._front:
             doc["shard_count"] = self.cfg.shards
-            doc["inbox_depth"] = sum(w._inbox.depth for w in self.workers)
+            doc["inbox_depth"] = sum(w._inbox_depth() for w in self.workers)
             doc["pending_ops"] = {
                 f"s{k}": sum(_pending_total(rt.pending)
                              for rt in w.types.values())

@@ -85,6 +85,10 @@ class SplitSafeKV(SafeKV):
     blocks), which is what keeps the GC frontier from freezing out — or
     running over — a remote process."""
 
+    # _round_step reads self._owned at trace time, so the shared-jit
+    # cache must key (and snapshot) it alongside the base statics
+    _TRACE_STATICS = SafeKV._TRACE_STATICS + ("_owned",)
+
     def __init__(self, cfg: DagConfig, spec, ops_per_block: int,
                  owned: np.ndarray, **kw):
         self._owned_np = np.asarray(owned, bool)

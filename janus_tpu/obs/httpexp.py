@@ -201,7 +201,12 @@ def federation_routes(peers: Sequence[Tuple[str, str]],
 
     def _slo() -> Tuple[str, str]:
         good, up = _fan("/slo")
-        merged = merge_slo([(lb, json.loads(t)) for lb, t in good])
+        # each peer's /slo may itself be a merged view (a sharded front
+        # folding its workers) — merge-of-merges works because a merged
+        # snapshot keeps the full bucket vectors; the per-peer scope
+        # label survives under nodes[label].scope for attribution
+        merged = merge_slo([(lb, json.loads(t)) for lb, t in good],
+                           scope="federation")
         merged["up"] = up
         return "application/json", json.dumps(merged)
 

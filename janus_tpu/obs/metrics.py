@@ -309,6 +309,13 @@ def shard_instruments(shard: int, registry: Optional[Registry] = None) -> dict:
       at the last step start (routing outpacing the worker -> growth)
     - ``shard{K}_step_lag_ms`` gauge: gap between worker K's successive
       steps (scheduling starvation shows up here before queue depth)
+    - ``shard{K}_inbox_hwm``   gauge (ratcheted via ``Gauge.max``): the
+      deepest worker K's ingress — Python inbox or native ring — has
+      ever been; bounded-growth evidence for the inbox-cap audit
+    - ``shard{K}_inbox_overflow_total`` counter: observations of depth
+      past the configured soft cap. A sensor, not a drop count — the
+      service sheds nothing yet, so the SLO ``shed`` counter staying
+      zero while this climbs is the admission-control to-do signal.
 
     ``render_prometheus`` emits ``# HELP``/``# TYPE`` lines for these
     like any other instrument.
@@ -318,4 +325,6 @@ def shard_instruments(shard: int, registry: Optional[Registry] = None) -> dict:
         "ops_total": reg.counter(f"shard{shard}_ops_total"),
         "queue_depth": reg.gauge(f"shard{shard}_queue_depth"),
         "step_lag": reg.gauge(f"shard{shard}_step_lag_ms"),
+        "inbox_hwm": reg.gauge(f"shard{shard}_inbox_hwm"),
+        "inbox_overflow": reg.counter(f"shard{shard}_inbox_overflow_total"),
     }

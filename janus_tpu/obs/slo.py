@@ -138,16 +138,23 @@ class SloLedger:
         }
 
 
-def merge_slo(parts: List[Tuple[str, dict]]) -> dict:
+def merge_slo(parts: List[Tuple[str, dict]], scope: str = "") -> dict:
     """Fold labeled per-instance ``SloLedger.snapshot()`` dicts into one
     cluster view: counters sum, bucket vectors sum, and per-class
     p50/p99 are recomputed from the MERGED counts. Each input snapshot
     also survives (sans bucket vectors) under ``nodes[label]`` so a
-    scrape can still attribute latency to a shard/host."""
+    scrape can still attribute latency to a shard/host.
+
+    ``scope`` labels the merged view itself (mirrors the leaf
+    ``SloLedger.snapshot()["scope"]``), so merge-of-merges — federation
+    over sharded fronts, each of which already merged its per-shard
+    ledgers — keeps every level attributable: a federation scrape shows
+    ``nodes[host].scope`` naming the host whose fold it is. A merged
+    snapshot is itself a valid ``parts`` input (same keys + counts)."""
     counts = {c: [0] * NUM_BUCKETS for c in OP_CLASSES}
     classes = {c: {"replied": 0, "e2e_samples": 0} for c in OP_CLASSES}
-    out = {"offered": 0, "admitted": 0, "shed": 0, "replied_total": 0,
-           "nodes": {}}
+    out = {"scope": scope, "offered": 0, "admitted": 0, "shed": 0,
+           "replied_total": 0, "nodes": {}}
     for label, snap in parts:
         for k in ("offered", "admitted", "shed", "replied_total"):
             out[k] += int(snap.get(k, 0))
@@ -161,6 +168,7 @@ def merge_slo(parts: List[Tuple[str, dict]]) -> dict:
                 for i, v in enumerate(vec[:NUM_BUCKETS]):
                     acc[i] += int(v)
         out["nodes"][label] = {
+            "scope": str(snap.get("scope", "") or label),
             "classes": {
                 c: {k: v
                     for k, v in ((snap.get("classes") or {})

@@ -50,6 +50,14 @@ def shard_of(type_code: str, key: str, num_shards: int) -> int:
     find its keys. Changing ``num_shards`` remaps keys (plain mod, not
     consistent hashing): shard count is a boot-time constant here, the
     same way the emulated node count is.
+
+    This function has a native twin — ``shard_of_key`` in
+    native/server.cc (exposed as ``janus_shard_of``), which the server's
+    zero-GIL demux uses to route decoded ops into per-shard rings on its
+    io thread. The two MUST stay byte-for-byte identical (FNV-1a 64-bit
+    over ``f"{type_code}/{key}"``, mod ``num_shards``); tests assert
+    parity over randomized inputs, so change both together or not at
+    all.
     """
     if num_shards <= 1:
         return 0

@@ -53,7 +53,9 @@ host-side logs.
 """
 from __future__ import annotations
 
+import threading
 import time
+import types
 from typing import Dict, Optional
 
 import jax
@@ -66,6 +68,61 @@ from janus_tpu.models import base
 from janus_tpu.obs import flight as obs_flight
 from janus_tpu.obs import stages as obs_stages
 from janus_tpu.obs.metrics import get_registry
+
+
+# Process-wide device-program cache. Every SafeKV whose TRACE-time
+# statics agree (same subclass, spec object, cluster geometry, block
+# width, budgets, collect flags, submit mask) lowers to the byte-same
+# XLA program, yet jitting bound methods per instance re-traced and
+# re-compiled it for every instance — ~2.6s per _step_device on one
+# CPU core, multiplied by every shard worker of every service. The
+# cache instead binds the device programs to a frozen statics snapshot
+# (_DeviceStatics) shared by every equal-statics instance: the first
+# instance pays the compile, the rest dispatch immediately. Cached
+# snapshots hold their spec OBJECT alive, so an id() can never be
+# reused by a different spec while its key is live.
+_JIT_CACHE: Dict[tuple, dict] = {}
+_FUSED_CACHE: Dict[tuple, dict] = {}
+_JIT_LOCK = threading.Lock()
+
+# the device-program methods rebound onto each statics snapshot; a
+# subclass override (e.g. SplitSafeKV._round_step) is picked up via
+# type(kv) lookup, and the subclass itself is part of the cache key
+_DEVICE_FNS = ("_submit_device", "_round_step", "_causal_closure",
+               "_delta_apply", "_state_transfer", "_tick_device",
+               "_step_device", "_step_k_device", "_compact_device")
+
+
+class _DeviceStatics:
+    """Frozen snapshot of every ``self.*`` value a SafeKV's device
+    programs read at trace time, with the device methods rebound onto
+    it. Jitted programs close over THIS object instead of the live kv,
+    so (a) equal-statics instances share one trace/compile and (b) a
+    later ``resize_block`` on a live kv can never leak its mutated B
+    into a shape-triggered retrace of a shared program — the resized
+    kv simply rebinds to a different cache entry."""
+
+    def __init__(self, kv: "SafeKV"):
+        for name in type(kv)._TRACE_STATICS:
+            setattr(self, name, getattr(kv, name))
+        for name in _DEVICE_FNS:
+            setattr(self, name,
+                    types.MethodType(getattr(type(kv), name), self))
+
+
+def _statics_key(kv: "SafeKV") -> tuple:
+    parts: list = [type(kv)]
+    for name in type(kv)._TRACE_STATICS:
+        v = getattr(kv, name)
+        if name == "cfg":
+            v = (v.num_nodes, v.num_rounds)
+        elif name == "spec":
+            v = id(v)  # pinned alive by the cached snapshot
+        elif isinstance(v, (np.ndarray, jnp.ndarray)):
+            a = np.asarray(v)
+            v = (a.shape, str(a.dtype), a.tobytes())
+        parts.append(v)
+    return tuple(parts)
 
 
 class SafeKV:
@@ -189,14 +246,44 @@ class SafeKV:
         # commit span can start nanoseconds before the seal it follows.
         self._flight = obs_flight.get_recorder()
         self._block_traces: Dict[tuple, tuple] = {}
-        self._jit_submit = jax.jit(self._submit_device)
-        self._jit_tick = jax.jit(self._tick_device)
-        self._jit_step = jax.jit(self._step_device)
-        self._jit_compact = (jax.jit(self._compact_device)
-                             if spec.compact_fence is not None else None)
-        self._jit_step_k = None  # built on first step_k_dispatch
+        self._bind_jits()
         # in-order absorb cursor for the split dispatch/absorb step path
         self._absorb_tick = 0
+
+    # every self.* value the device programs read at TRACE time — both
+    # the shared-jit cache key and the frozen statics snapshot derive
+    # from this list (subclasses reading more statics must extend it)
+    _TRACE_STATICS = ("cfg", "spec", "B", "apply_budget", "commit_steps",
+                      "seed", "collect", "collect_logs", "_submit_mask")
+
+    def _bind_jits(self) -> None:
+        """Bind this instance's jitted device programs from the
+        process-wide cache (compiling them on first use of this static
+        signature). Called at init and again by ``resize_block`` — B is
+        a trace-time static, so a resized kv must move to the entry for
+        its new width rather than mutate a shared one."""
+        key = _statics_key(self)
+        with _JIT_LOCK:
+            entry = _JIT_CACHE.get(key)
+            if entry is None:
+                st = _DeviceStatics(self)
+                entry = {
+                    "statics": st,
+                    "submit": jax.jit(st._submit_device),
+                    "tick": jax.jit(st._tick_device),
+                    "step": jax.jit(st._step_device),
+                    "step_k": jax.jit(st._step_k_device),
+                    "compact": (jax.jit(st._compact_device)
+                                if self.spec.compact_fence is not None
+                                else None),
+                }
+                _JIT_CACHE[key] = entry
+        self._statics = entry["statics"]
+        self._jit_submit = entry["submit"]
+        self._jit_tick = entry["tick"]
+        self._jit_step = entry["step"]
+        self._jit_compact = entry["compact"]
+        self._jit_step_k = entry["step_k"]
 
     # -- device programs ---------------------------------------------------
 
@@ -590,8 +677,6 @@ class SafeKV:
         """Dispatch K fused rounds; returns (packed_k, metas). Pass both
         to ``step_k_absorb`` in dispatch order. ``ops_k``: [K, N, B] per
         field; ``safe_k``: optional [K, N, B] bools."""
-        if self._jit_step_k is None:
-            self._jit_step_k = jax.jit(self._step_k_device)
         k = int(next(iter(ops_k.values())).shape[0])
         (self.prospective, self.stable, self.dag, self.commit,
          self.ops_buffer, self.buffer_filled, self.prosp_applied,
@@ -704,6 +789,7 @@ class SafeKV:
                 self.pending_safe_acks, ((0, 0), (0, 0), (0, pad)))
         self.B = new_b
         self.stats["block_resizes"] += 1
+        self._bind_jits()  # B is a trace-time static: move cache entries
         return True
 
     # -- host API ----------------------------------------------------------
@@ -1146,8 +1232,20 @@ class MultiKV:
         self.kvs = dict(kvs)
         self._names = tuple(sorted(kvs))
         self._jit = None
-        self.trace_count = 0      # +1 per (re)trace — the recompile guard
+        self._fused_entry = None  # shared-cache entry backing self._jit
+        self._traces0 = 0         # entry trace counter at attach time
+        self._built_statics = None
         self.dispatch_count = 0   # +1 per megatick dispatch
+
+    @property
+    def trace_count(self) -> int:
+        """Traces of this MultiKV's fused program since it attached —
+        the recompile-storm guard. The program lives in the process-wide
+        shared cache, so a MultiKV whose geometry was already compiled
+        by an earlier instance legitimately reports 0."""
+        if self._fused_entry is None:
+            return 0
+        return self._fused_entry["traces"] - self._traces0
 
     def _carry(self, kv: SafeKV):
         return (kv.prospective, kv.stable, kv.dag, kv.commit, kv.ops_buffer,
@@ -1160,24 +1258,40 @@ class MultiKV:
          kv.force_transfer) = carry
 
     def _build(self):
-        names, kvs = self._names, self.kvs
-        multi = self
+        """Fetch (or compile) the fused program from the process-wide
+        cache. The scan body steps each kv through its frozen statics
+        snapshot — never through the live kv — so equal-geometry
+        MultiKVs share one compile and a later resize_block on a member
+        kv cannot leak into a shared trace (dispatch detects the
+        snapshot swap and rebuilds against the new entry)."""
+        names = self._names
+        statics = {name: self.kvs[name]._statics for name in names}
+        key = tuple((name, _statics_key(self.kvs[name])) for name in names)
+        with _JIT_LOCK:
+            entry = _FUSED_CACHE.get(key)
+            if entry is None:
+                entry = {"traces": 0, "statics": statics}
 
-        def fused(carries, ops_k):
-            multi.trace_count += 1  # python side effect: runs at TRACE time
+                def fused(carries, ops_k):
+                    entry["traces"] += 1  # python side effect: TRACE time
 
-            def body(carry, ops):
-                nxt, packed = {}, {}
-                for name in names:
-                    out = kvs[name]._step_device(
-                        *carry[name], ops[name], None, None, None)
-                    nxt[name] = out[:9]
-                    packed[name] = out[9]
-                return nxt, packed
+                    def body(carry, ops):
+                        nxt, packed = {}, {}
+                        for name in names:
+                            out = statics[name]._step_device(
+                                *carry[name], ops[name], None, None, None)
+                            nxt[name] = out[:9]
+                            packed[name] = out[9]
+                        return nxt, packed
 
-            return jax.lax.scan(body, carries, ops_k)
+                    return jax.lax.scan(body, carries, ops_k)
 
-        return jax.jit(fused)
+                entry["fn"] = jax.jit(fused)
+                _FUSED_CACHE[key] = entry
+        self._fused_entry = entry
+        self._traces0 = entry["traces"]
+        self._built_statics = statics
+        return entry["fn"]
 
     def step_k_dispatch(self, ops_k: Dict[str, base.OpBatch], safe_k=None,
                         record=True):
@@ -1186,7 +1300,9 @@ class MultiKV:
         metas)`` dicts keyed like ``self.kvs``; pass both to
         ``step_k_absorb`` in dispatch order. ``safe_k`` and ``record``
         may be dicts keyed by kv name or one value for every kv."""
-        if self._jit is None:
+        if self._jit is None or any(
+                self.kvs[n]._statics is not self._built_statics[n]
+                for n in self._names):  # a member kv rebound (resize)
             self._jit = self._build()
         k = int(next(iter(next(iter(ops_k.values())).values())).shape[0])
         carries = {name: self._carry(self.kvs[name]) for name in self._names}

@@ -61,9 +61,10 @@ def _smoke_cfg(name, cfg):
     elif cfg.mode == "wire_native":
         over = dict(num_objects=32, ops_per_block=256, clients=2,
                     ops_per_client=3000, pipeline=64)
-    elif cfg.mode == "wire_sharded":
+    elif cfg.mode in ("wire_sharded", "wire_sharded_native"):
         # both A/B arms run the same shrunken schedule; the run's own
-        # bit-equality gate (sharded vs unsharded final state) is the
+        # bit-equality gate (sharded vs unsharded final state, or
+        # native-demux vs Python-router state) is the
         # assertion under test, plus the SLO-plane gate (smoke_slo_plane
         # row): the timed window must be 100s of ms, not tens, so the
         # out-of-band scraper's fixed per-probe CPU (a few ms per
@@ -224,6 +225,26 @@ def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
                 failures.append((name, overhead))
             if cfg.mode == "wire_sharded":
                 slo_payload = payload
+            if cfg.mode == "wire_sharded_native":
+                # demux gates: the native ring must reproduce the
+                # Python router's state bit-for-bit over the same
+                # schedule, the native arm's ledger must reconcile
+                # exactly (every offered op replied), and the oob
+                # plane must stay within its CPU budget while the
+                # native arm is loaded
+                nsr = payload.get("slo_report") or {}
+                noob = payload.get("oob") or {}
+                nrecon = abs(float(nsr.get("replied_vs_total", 0.0)) - 1.0)
+                for gate, bad, frac in (
+                        ("sharded_native(states not bitequal)",
+                         payload.get("states_bitequal") is not True, 1.0),
+                        ("sharded_native(counter reconciliation)",
+                         nrecon > 0.01, nrecon),
+                        ("sharded_native(obs cpu_frac)",
+                         float(noob.get("cpu_frac", 1.0)) >= 0.02,
+                         float(noob.get("cpu_frac", 1.0)))):
+                    if bad:
+                        failures.append((gate, frac))
 
         # flight-recorder overhead row: the light fixed-B preset again
         # (its jit cache is warm from the loop above, so elapsed is

@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""Multi-host scale-out sweep (ISSUE 17, PERF round 8): N independent
+sharded service processes — each its own native router (zero-GIL demux
+io thread) in front of K shard workers — launched via
+start_split_cluster.py's service-hosts mode, driven CONCURRENTLY by
+open-loop BatchSender fleets, scoreboarded by one federation process
+whose ``federation_routes`` merge every host's /slo into a single
+cluster ledger.
+
+The aggregate-goodput row is honest the same way the single-host bench
+is: per-host completion is server-side (every scheduled op arrived,
+nothing pending or inboxed, replies caught up), the window closes at
+the LAST host's drain, and the federation ledger's replied delta must
+reconcile exactly against the scheduled op total. Every host replays
+the identical deterministic schedule, so the post-run read-back checks
+every host's final state against the same predicted sums.
+
+    python scripts/run_multihost_sweep.py --hosts 1 2 --shards 2 \\
+        --out results_r8.jsonl
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import pathlib
+import socket
+import sys
+import threading
+import time
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def _load_launcher():
+    spec = importlib.util.spec_from_file_location(
+        "start_split_cluster",
+        str(REPO_ROOT / "scripts" / "start_split_cluster.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _wait_port(port: int, timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=1.0):
+                return
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError(f"port {port} never came up")
+
+
+class HostDriver:
+    """Open-loop driver for one pre-started service host: prep (key
+    creates + warmup frame, identical across hosts so the sums cancel
+    in the read-back check), a barrier-synchronized drive of the shared
+    schedule, server-side drain wait, and post-window read-back."""
+
+    def __init__(self, index: int, port: int, schedule, keys):
+        from janus_tpu.net import JanusClient
+
+        self.index = index
+        self.port = port
+        self.schedule = schedule
+        self.keys = keys
+        self.total = int(schedule["total_ops"])
+        self.pre = JanusClient("127.0.0.1", port, timeout=120)
+        self._polls = 0
+        self.t0 = self.t_send = self.t_done = 0.0
+        self.error: Exception | None = None
+
+    def _stats(self) -> dict:
+        self._polls += 1
+        return json.loads(
+            self.pre.request("stats", "_", "g", timeout=120)["result"])
+
+    def prep(self) -> None:
+        from janus_tpu.net.client import BatchSender
+
+        for k in self.keys:
+            self.pre.request("pnc", k, "s", timeout=120)
+        warm = BatchSender("127.0.0.1", self.port)
+        warm.send_frame("pnc", self.keys, self.schedule["warm_idx"], "i",
+                        p0=self.schedule["warm_p0"])
+        time.sleep(1.0)
+        warm.close()
+        # settle: the warmup fully drained before the ledger baseline
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = self._stats()
+            pending = st["types"]["pnc"].get("pending_ops", 0)
+            if pending == 0 and st.get("inbox_depth", 0) == 0:
+                break
+            time.sleep(0.1)
+        st = self._stats()
+        self._ops0 = st["ops_received"] - self._polls
+        self._lag0 = st["ops_received"] - st["replies_sent"]
+
+    def drive(self, barrier: threading.Barrier) -> None:
+        from janus_tpu.net.client import BatchSender
+
+        try:
+            senders = [BatchSender("127.0.0.1", self.port)
+                       for _ in self.schedule["per_client"]]
+
+            def _one(s, frames):
+                for idx, p0 in frames:
+                    s.send_frame("pnc", self.keys, idx, "i", p0=p0)
+
+            threads = [threading.Thread(target=_one, args=(s, fr))
+                       for s, fr in zip(senders,
+                                        self.schedule["per_client"])]
+            barrier.wait()
+            self.t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            self.t_send = time.perf_counter()
+            deadline = time.monotonic() + 600
+            while True:
+                st = self._stats()
+                arrived = st["ops_received"] - self._polls - self._ops0
+                lag = st["ops_received"] - st["replies_sent"]
+                pending = st["types"]["pnc"].get("pending_ops", 0)
+                inbox = st.get("inbox_depth", 0)
+                if arrived >= self.total and lag <= self._lag0 \
+                        and pending == 0 and inbox == 0:
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"host {self.index} stalled: {arrived}/"
+                        f"{self.total} arrived, {pending} pending, "
+                        f"{inbox} inboxed, lag {lag}")
+                time.sleep(0.025)
+            self.t_done = time.perf_counter()
+            for s in senders:
+                s.close()
+        except Exception as e:  # surfaced by the sweep after join
+            self.error = e
+
+    def readback(self) -> list:
+        finals = []
+        for k in self.keys:
+            rep = self.pre.request("pnc", k, "gp", timeout=120)
+            finals.append(int(rep["result"]))
+        return finals
+
+    def close(self) -> None:
+        self.pre.close()
+
+
+def run_sweep(host_counts, shards, bench, out_path, logdir_base,
+              fed_port=9155, native=True):
+    from janus_tpu.bench.harness import _sharded_schedule, slo_report
+    from janus_tpu.obs.httpexp import scrape_json
+
+    launcher = _load_launcher()
+    schedule, expect = _sharded_schedule(bench)
+    n_keys = int(schedule["n_keys"])
+    keys = [f"o{k}" for k in range(n_keys)]
+    expect_l = expect.tolist()
+    rows = []
+    with open(out_path, "a") as out:
+        for n in host_counts:
+            logdir = os.path.join(logdir_base, f"hosts{n}")
+            os.makedirs(logdir, exist_ok=True)
+            cluster = {
+                "num_nodes": bench.num_nodes, "window": bench.window,
+                "ops_per_block": bench.ops_per_block,
+                "max_clients": bench.clients + 8,
+                "shards": shards, "native_demux": native,
+                "ingest_batch": bench.ingest_batch,
+                "types": [{"type_code": "pnc",
+                           "dims": {"num_keys": n_keys}}],
+                "federation": {"port": fed_port},
+                "hosts": [{"client_port": 5300 + i, "obs_port": 9300 + i}
+                          for i in range(n)],
+            }
+            cpath = os.path.join(logdir, "cluster.json")
+            with open(cpath, "w") as f:
+                json.dump(cluster, f)
+            launcher.start(cpath, logdir, "warning")
+            drivers = []
+            try:
+                for i in range(n):
+                    _wait_port(5300 + i, timeout=120)
+                    _wait_port(9300 + i, timeout=120)
+                _wait_port(fed_port, timeout=120)
+                drivers = [HostDriver(i, 5300 + i, schedule, keys)
+                           for i in range(n)]
+                for d in drivers:
+                    d.prep()
+                fed_base = f"http://127.0.0.1:{fed_port}"
+                fed0 = scrape_json(fed_base + "/slo")
+                # drive all hosts CONCURRENTLY from one barrier
+                barrier = threading.Barrier(n)
+                threads = [threading.Thread(target=d.drive,
+                                            args=(barrier,))
+                           for d in drivers]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                for d in drivers:
+                    if d.error is not None:
+                        raise d.error
+                fed1 = scrape_json(fed_base + "/slo")
+                # read-back AFTER the ledger window closes, so the gp
+                # reads never pollute the reconciliation deltas
+                for d in drivers:
+                    finals = d.readback()
+                    assert finals == expect_l, (
+                        f"host {d.index} final state diverges from the "
+                        f"schedule's predicted sums: {finals[:8]}... vs "
+                        f"{expect_l[:8]}...")
+                window = (max(d.t_done for d in drivers)
+                          - min(d.t0 for d in drivers))
+                total_all = sum(d.total for d in drivers)
+                agg_goodput = total_all / window
+                rep = slo_report(fed0, fed1, agg_goodput, total_all)
+                row = {
+                    "run": f"multihost_{n}x{shards}",
+                    "ts": round(time.time(), 1),
+                    "hosts": n, "shards_per_host": shards,
+                    "native_demux": native,
+                    "router_procs": n,
+                    "shard_workers_total": n * shards,
+                    "ops_per_host": drivers[0].total,
+                    "total_ops": total_all,
+                    "window_s": round(window, 3),
+                    "aggregate_offered_ops_per_sec": round(
+                        sum(d.total / (d.t_send - d.t0)
+                            for d in drivers), 1),
+                    "aggregate_goodput_ops_per_sec": round(
+                        agg_goodput, 1),
+                    "per_host_goodput_ops_per_sec": [
+                        round(d.total / (d.t_done - d.t0), 1)
+                        for d in drivers],
+                    "states_bitequal": True,
+                    "federation": {
+                        "up": fed1.get("up"),
+                        "nodes": sorted((fed1.get("nodes") or {})),
+                        "scope": fed1.get("scope"),
+                    },
+                    "slo_report": rep,
+                }
+                line = json.dumps(row)
+                print(line, flush=True)
+                out.write(line + "\n")
+                out.flush()
+                rows.append(row)
+            finally:
+                for d in drivers:
+                    try:
+                        d.close()
+                    except Exception:
+                        pass
+                launcher.stop(logdir)
+    return rows
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--hosts", nargs="+", type=int, default=[1, 2])
+    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--ops-per-client", type=int, default=262144)
+    ap.add_argument("--frame-ops", type=int, default=4096)
+    ap.add_argument("--num-objects", type=int, default=64)
+    # 128 matches the round-8 single-host finding: the delta combiner
+    # collapses each drain to <= num_objects lanes, so device rounds
+    # are pure B-cost — bigger blocks only burn dead lanes
+    ap.add_argument("--ops-per-block", type=int, default=128)
+    ap.add_argument("--python-router", action="store_true",
+                    help="drive the Python-router demux instead of the "
+                         "native ring (A/B at the cluster level)")
+    ap.add_argument("--out", default="results_r8.jsonl")
+    ap.add_argument("--logdir", default="/tmp/janus_multihost")
+    ap.add_argument("--fed-port", type=int, default=9155)
+    args = ap.parse_args()
+
+    import dataclasses as dc
+
+    from janus_tpu.bench.harness import PRESETS
+
+    bench = dc.replace(
+        PRESETS["wire_sharded"], clients=args.clients,
+        ops_per_client=args.ops_per_client, frame_ops=args.frame_ops,
+        num_objects=args.num_objects, shards=args.shards,
+        ops_per_block=args.ops_per_block)
+    rows = run_sweep(args.hosts, args.shards, bench, args.out,
+                     args.logdir, fed_port=args.fed_port,
+                     native=not args.python_router)
+    print("# hosts  routers  shard_workers  aggregate_goodput_ops_per_s")
+    for r in rows:
+        print(f"#  {r['hosts']:>4}  {r['router_procs']:>7}  "
+              f"{r['shard_workers_total']:>13}  "
+              f"{r['aggregate_goodput_ops_per_sec']:>26,.0f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,28 @@ optional ``ssh``/``workdir`` fields make a proc remote):
 A proc row's optional ``obs_port`` starts that process's out-of-band
 obs endpoint (/metrics /stats /health /slo /trace); federate them with
 ``python -m janus_tpu.obs.httpexp --peer p0=http://host:9100 ...``.
+
+Service-hosts mode (the ISSUE-17 scale-out topology): a config with
+``"hosts"`` instead of ``"procs"`` launches M INDEPENDENT sharded
+service processes — each host is its own native router (io thread +
+zero-GIL shard demux) in front of ``shards`` worker threads, with NO
+DAG plane between hosts (shards > 1 is incompatible with a split
+cluster; scale-out multiplies whole service stacks). An optional
+``"federation"`` block starts one scoreboard process whose
+``federation_routes`` merge every host's /slo, /metrics and /health
+into a single cluster view:
+
+  {"num_nodes": 4, "window": 8, "ops_per_block": 256,
+   "shards": 2, "native_demux": true,
+   "types": [{"type_code": "pnc", "dims": {"num_keys": 64}}],
+   "federation": {"port": 9100},
+   "hosts": [
+     {"client_port": 5100, "obs_port": 9101},
+     {"client_port": 5101, "obs_port": 9102, "shards": 4}]}
+
+Host rows override top-level keys (per-host shard counts); ``ssh`` /
+``workdir`` make a host remote exactly like a proc row. ``stop`` and
+``status`` cover the federation process too (it is in the pids file).
 """
 from __future__ import annotations
 
@@ -101,12 +123,80 @@ def remote_start_cmds(ssh: str, workdir: str, cfg_path: str, index: int,
     ]
 
 
+def start_hosts(cfg: dict, logdir: str, log_level: str = "info") -> None:
+    """Service-hosts mode: one standalone (optionally sharded) service
+    process per ``hosts`` row — no DAG plane, no proc_index — plus an
+    optional federation scoreboard merging every host's obs endpoint."""
+    hosts = cfg["hosts"]
+    pids = []
+    peers = []
+    for i, h in enumerate(hosts):
+        # per-host config = top-level keys, minus the topology blocks,
+        # overridden by the host row (per-host shards/native_demux/...)
+        per = {k: v for k, v in cfg.items()
+               if k not in ("hosts", "federation", "procs")}
+        per.update({k: v for k, v in h.items()
+                    if k not in ("ssh", "workdir", "client_port")})
+        per["port"] = int(h.get("client_port", 0))
+        per["bind_addr"] = h.get("address", "127.0.0.1")
+        per["obs_port"] = int(h.get("obs_port", -1))
+        per["log_level"] = log_level
+        cfg_path = os.path.join(logdir, f"host{i}.json")
+        with open(cfg_path, "w") as f:
+            json.dump(per, f)
+        if per["obs_port"] >= 0:
+            peers.append((f"h{i}",
+                          f"http://{per['bind_addr']}:{per['obs_port']}"))
+        ssh = h.get("ssh")
+        if ssh:
+            workdir = h.get("workdir", "~/janus")
+            pid = None
+            for cmd in remote_start_cmds(ssh, workdir, cfg_path, i,
+                                         logdir, log_level):
+                out = _run(cmd, check=True, capture_output=True, text=True)
+                pid = (out.stdout or "").strip() or pid
+            pids.append(f"{ssh}:{pid}")
+            print(f"host {i}: remote {ssh} pid {pid} "
+                  f"client={per['bind_addr']}:{per['port']} "
+                  f"shards={per.get('shards', 1)} obs={per['obs_port']}")
+        else:
+            log = open(os.path.join(logdir, f"host{i}.log"), "w")
+            child = subprocess.Popen(
+                [sys.executable, "-m", "janus_tpu.net.service", cfg_path,
+                 "0", "--log-level", log_level],
+                stdout=log, stderr=subprocess.STDOUT, cwd=REPO_ROOT,
+            )
+            pids.append(str(child.pid))
+            print(f"host {i}: pid {child.pid} "
+                  f"client={per['bind_addr']}:{per['port']} "
+                  f"shards={per.get('shards', 1)} obs={per['obs_port']}")
+    fed = cfg.get("federation")
+    if fed and peers:
+        fed_cmd = [sys.executable, "-m", "janus_tpu.obs.httpexp",
+                   "--port", str(int(fed.get("port", 9100))),
+                   "--bind", fed.get("bind", "127.0.0.1")]
+        for label, url in peers:
+            fed_cmd += ["--peer", f"{label}={url}"]
+        log = open(os.path.join(logdir, "federation.log"), "w")
+        child = subprocess.Popen(fed_cmd, stdout=log,
+                                 stderr=subprocess.STDOUT, cwd=REPO_ROOT)
+        pids.append(str(child.pid))
+        print(f"federation: pid {child.pid} "
+              f"port {fed.get('port', 9100)} ({len(peers)} peers)")
+    with open(os.path.join(logdir, "pids"), "w") as f:
+        f.write("\n".join(pids))
+    print(f"{len(pids)} processes started; logs in {logdir}")
+
+
 def start(cluster_json: str, logdir: str, log_level: str = "info") -> None:
     os.makedirs(logdir, exist_ok=True)
     cfg = json.loads(open(cluster_json).read())
+    if cfg.get("hosts"):
+        start_hosts(cfg, logdir, log_level)
+        return
     procs = cfg.get("procs", [])
     if not procs:
-        sys.exit("config has no 'procs' — nothing to split")
+        sys.exit("config has no 'procs' and no 'hosts' — nothing to run")
     pids = []
     for i, p in enumerate(procs):
         per = dict(cfg)
@@ -138,7 +228,7 @@ def start(cluster_json: str, logdir: str, log_level: str = "info") -> None:
             child = subprocess.Popen(
                 [sys.executable, "-m", "janus_tpu.net.service", cfg_path,
                  str(i), "--log-level", log_level],
-                stdout=log, stderr=subprocess.STDOUT,
+                stdout=log, stderr=subprocess.STDOUT, cwd=REPO_ROOT,
             )
             pids.append(str(child.pid))
             print(f"proc {i}: pid {child.pid} client={per['bind_addr']}:"

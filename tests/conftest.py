@@ -28,3 +28,20 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def native_lib():
+    """Build-or-skip gate for tests that exercise the native runtime.
+
+    ``binding.load()`` rebuilds libjanus_native.so whenever any native
+    source is newer than the binary (and the Makefile's -MMD deps keep
+    the object cache honest), so a test that takes this fixture can
+    never run against a stale .so — the failure mode that makes native
+    changes look like test flakes. When the toolchain is absent the
+    dependent tests SKIP with the build error instead of failing."""
+    from janus_tpu.net import binding
+    try:
+        return binding.load()
+    except Exception as e:  # missing g++ / failed compile
+        pytest.skip(f"native runtime unavailable: {e}")

@@ -68,6 +68,57 @@ def test_start_remote_collects_ssh_pid(tmp_path, monkeypatch):
     assert shipped["proc_index"] == 0
 
 
+def test_start_hosts_mode_spawns_services_and_federation(tmp_path,
+                                                         monkeypatch):
+    """Service-hosts mode (ISSUE 17): one standalone sharded service
+    per host row — per-host JSON must NOT carry the topology blocks
+    (shards > 1 + procs is a config error service-side) — plus one
+    federation scoreboard process peered at every host's obs port."""
+    spawned = []
+
+    def fake_popen(cmd, **kw):
+        spawned.append(cmd)
+
+        class Child:
+            pid = 40000 + len(spawned)
+
+        return Child()
+
+    monkeypatch.setattr(launcher.subprocess, "Popen", fake_popen)
+    cfg = {
+        "num_nodes": 2, "window": 8, "ops_per_block": 8,
+        "shards": 2, "native_demux": True,
+        "types": [{"type_code": "pnc", "dims": {"num_keys": 8}}],
+        "federation": {"port": 9100},
+        "hosts": [
+            {"client_port": 5100, "obs_port": 9101},
+            {"client_port": 5101, "obs_port": 9102, "shards": 4},
+        ],
+    }
+    p = tmp_path / "cluster.json"
+    p.write_text(json.dumps(cfg))
+    logs = tmp_path / "logs"
+    launcher.start(str(p), str(logs), "warning")
+    host0 = json.loads((logs / "host0.json").read_text())
+    host1 = json.loads((logs / "host1.json").read_text())
+    for h in (host0, host1):
+        assert "procs" not in h and "hosts" not in h
+        assert "federation" not in h
+        assert h["native_demux"] is True
+        assert h["log_level"] == "warning"
+    assert host0["port"] == 5100 and host0["obs_port"] == 9101
+    assert host0["shards"] == 2
+    assert host1["shards"] == 4  # host row overrides the top level
+    # 2 service hosts + 1 federation scoreboard, all in the pids file
+    assert len(spawned) == 3
+    assert (logs / "pids").read_text().split() == [
+        "40001", "40002", "40003"]
+    fed = spawned[2]
+    assert "janus_tpu.obs.httpexp" in fed
+    assert "h0=http://127.0.0.1:9101" in fed
+    assert "h1=http://127.0.0.1:9102" in fed
+
+
 def test_log_configure_levels():
     from janus_tpu.utils.log import LEVELS, configure, get_logger
     configure("warning")

@@ -107,9 +107,10 @@ def test_multikv_matches_separate_safekvs():
 
 def test_multikv_one_dispatch_per_k_rounds_and_compiles_once():
     """The perf claim, asserted via counters: >= 3 two-type megaticks
-    cost trace_count == 1 (jax compiled the fused program exactly once)
-    and dispatch_count == one per megatick — not one per type, not one
-    per round."""
+    cost trace_count <= 1 (jax compiled the fused program at most once
+    — 0 when an earlier same-geometry MultiKV already populated the
+    process-wide shared program cache) and dispatch_count == one per
+    megatick — not one per type, not one per round."""
     rng = np.random.default_rng(9)
     minters = [TagMinter(v) for v in range(N)]
     multi = MultiKV({"pnc": _pnc_kv(), "orset": _orset_kv()})
@@ -117,7 +118,7 @@ def test_multikv_one_dispatch_per_k_rounds_and_compiles_once():
     for _ in range(megaticks):
         multi.step_k({"pnc": _pnc_ops(rng, K),
                       "orset": _orset_ops(rng, K, minters)})
-    assert multi.trace_count == 1
+    assert multi.trace_count <= 1
     assert multi.dispatch_count == megaticks
     # every kv really advanced K rounds per megatick
     for kv in multi.kvs.values():

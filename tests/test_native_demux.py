@@ -1,0 +1,382 @@
+"""Native zero-GIL shard demux (ISSUE 17): the server routes decoded
+batch-frame columns (and per-op ClientMessages) into per-shard native
+rings on its io thread, keyed by the same FNV-1a shard_of as the Python
+router. Contracts under test:
+
+- ``janus_shard_of`` is byte-for-byte ``runtime.keyspace.shard_of``
+  over randomized type codes / key names / shard counts (plus pinned
+  oracle values, so BOTH implementations drifting together still
+  fails);
+- ring routing is bit-identical to Python shard_of end to end: every
+  op drained from ring K names a key whose shard_of is K, columns
+  (op/params/t0_ns) intact, router queue untouched by data ops;
+- a sharded service produces the same final CRDT state with the native
+  demux as with the Python router fallback and as unsharded — over
+  randomized keys, exercising the worker's (home, key) -> slot
+  fast-slot priming on native-drained columns;
+- t0_ns propagation: stamped v2 frames and unstamped v1 frames land in
+  the SLO ledger identically (same replied / e2e-sample accounting)
+  whether ops arrive via the native ring or the Python router.
+"""
+import json
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from janus_tpu.net import JanusClient, JanusConfig, JanusService, TypeConfig
+from janus_tpu.net.client import BatchSender, encode_client_message, frame0
+from janus_tpu.runtime.keyspace import shard_of
+
+pytestmark = pytest.mark.usefixtures("native_lib")
+
+
+# -- shard_of parity -------------------------------------------------------
+
+# pinned oracles: independent of BOTH implementations, so a bug that
+# changes the hash in lockstep (e.g. editing the seed in both files)
+# still trips
+_ORACLE = [
+    (("pnc", "o0", 2), 0), (("pnc", "o1", 2), 1),
+    (("pnc", "o2", 2), 0), (("pnc", "o3", 2), 1),
+    (("pnc", "o0", 4), 2), (("pnc", "o1", 4), 1),
+    (("pnc", "o2", 4), 0), (("pnc", "o3", 4), 3),
+    (("orset", "o0", 4), 2), (("pnc", "user:42", 7), 3),
+]
+
+
+def test_shard_of_oracle_values():
+    from janus_tpu.net.binding import native_shard_of
+    for (tc, key, n), want in _ORACLE:
+        assert shard_of(tc, key, n) == want, (tc, key, n)
+        assert native_shard_of(tc, key, n) == want, (tc, key, n)
+
+
+def test_shard_of_native_parity_randomized(rng):
+    from janus_tpu.net.binding import native_shard_of
+    codes = ["pnc", "orset", "lww", "tpset", "mvr", "x", "stats"]
+    alphabet = ("abcdefghijklmnopqrstuvwxyz"
+                "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789:_-./")
+    for _ in range(3000):
+        tc = codes[int(rng.integers(len(codes)))]
+        klen = int(rng.integers(1, 40))
+        key = "".join(alphabet[int(i)]
+                      for i in rng.integers(0, len(alphabet), klen))
+        n = int(rng.integers(1, 64))
+        assert native_shard_of(tc, key, n) == shard_of(tc, key, n), \
+            (tc, key, n)
+    # degenerate shard counts collapse to shard 0
+    assert native_shard_of("pnc", "k", 1) == 0
+    assert native_shard_of("pnc", "k", 0) == 0
+
+
+# -- ring routing vs Python shard_of ---------------------------------------
+
+def _v2_frame(seq0, type_code, keys, key_idx, op, p0, t0_ns):
+    from janus_tpu.net.client import encode_batch_frame
+    m = len(key_idx)
+    return encode_batch_frame(
+        seq0, type_code, keys,
+        np.asarray(key_idx, np.int32),
+        np.full(m, ord(op), np.uint8),
+        np.zeros(m, np.uint8),
+        np.asarray(p0, np.int64), t0_ns=t0_ns)
+
+
+def _v1_frame(seq0, type_code, keys, key_idx, op, p0):
+    """Hand-built version-1 batch frame: no t0_ns in the header, so
+    every op counts as unstamped (old clients)."""
+    tc = type_code.encode()
+    head = bytearray([0x00, 1, len(tc)])
+    head += tc
+    head += struct.pack("<I", seq0 & 0xFFFFFFFF)
+    head += struct.pack("<H", len(keys))
+    for k in keys:
+        kb = k.encode()
+        head += struct.pack("<H", len(kb)) + kb
+    m = len(key_idx)
+    head += struct.pack("<I", m)
+    head += np.asarray(key_idx, np.int32).tobytes()
+    head += np.full(m, ord(op), np.uint8).tobytes()
+    head += np.zeros(m, np.uint8).tobytes()
+    head += np.asarray(p0, np.int64).tobytes()
+    return bytes(head)
+
+
+def test_ring_routing_bit_identical_to_python(rng):
+    """Drain every ring of a raw NativeServer and check each op landed
+    on exactly the ring Python shard_of names, with columns intact."""
+    from janus_tpu.net.binding import NativeServer
+    srv = NativeServer("127.0.0.1", 0, 32)
+    shards = 4
+    keys = [f"k{int(rng.integers(1 << 30)):x}" for _ in range(48)]
+    try:
+        tids = {tc: srv.register_type(tc, 64) for tc in ("pnc", "orset")}
+        srv.set_shards(shards)
+        port = srv.start()
+        m = 512
+        idx = rng.integers(0, len(keys), m).astype(np.int32)
+        p0 = rng.integers(1, 100, m).astype(np.int64)
+        with socket.create_connection(("127.0.0.1", port)) as sk:
+            # one stamped v2 frame per type (same key dict, so slot i
+            # of either type is keys[i]) + a few per-op messages, which
+            # take the protobuf handle_payload path into the same rings
+            sk.sendall(frame0(_v2_frame(1, "pnc", keys, idx, "i", p0,
+                                        t0_ns=123456789)))
+            sk.sendall(frame0(_v2_frame(m + 1, "orset", keys, idx, "a",
+                                        p0, t0_ns=987654321)))
+            per_op = 16
+            for j in range(per_op):
+                sk.sendall(frame0(encode_client_message(
+                    2 * m + 1 + j, keys[j], "pnc", "i", ["5"],
+                    t0_ns=42)))
+            total = 2 * m + per_op
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if sum(srv.shard_depth(s) for s in range(shards)) >= total:
+                    break
+                time.sleep(0.02)
+            drained = 0
+            for s in range(shards):
+                cols = srv.poll_batch_shard(s, total)
+                n = len(cols["client_tag"])
+                drained += n
+                assert srv.shard_hwm(s) >= n
+                for i in range(n):
+                    tc = ("pnc" if int(cols["type_id"][i]) == tids["pnc"]
+                          else "orset")
+                    key = keys[int(cols["key_slot"][i])]
+                    assert shard_of(tc, key, shards) == s, (tc, key, s)
+                    assert int(cols["t0_ns"][i]) in (123456789, 987654321,
+                                                     42)
+                assert len(srv.poll_batch_shard(s, 16)["client_tag"]) == 0
+            assert drained == total
+            # data ops never touched the router queue
+            assert srv.router_depth() == 0
+            assert len(srv.poll_batch(64)["client_tag"]) == 0
+    finally:
+        srv.close()
+
+
+def test_pinned_type_stays_on_router_queue():
+    from janus_tpu.net.binding import NativeServer
+    srv = NativeServer("127.0.0.1", 0, 8)
+    try:
+        tid = srv.register_type("stats", 4)
+        srv.set_shards(2)
+        srv.pin_type_router(tid)
+        port = srv.start()
+        with socket.create_connection(("127.0.0.1", port)) as sk:
+            sk.sendall(frame0(encode_client_message(1, "_", "stats", "g")))
+            deadline = time.time() + 30
+            while time.time() < deadline and srv.router_depth() < 1:
+                time.sleep(0.02)
+        assert srv.router_depth() == 1
+        assert srv.shard_depth(0) == 0 and srv.shard_depth(1) == 0
+        cols = srv.poll_batch(16)
+        assert len(cols["client_tag"]) == 1
+        assert int(cols["type_id"][0]) == tid
+    finally:
+        srv.close()
+
+
+# -- service-level state parity (fast-slot priming rides along) ------------
+
+def _mk_service(shards: int, native: bool) -> JanusService:
+    return JanusService(JanusConfig(
+        num_nodes=4, window=8, ops_per_block=16, shards=shards,
+        native_demux=native,
+        types=(TypeConfig("pnc", {"num_keys": 64}),)))
+
+
+def _drive_frames(svc: JanusService, port: int, keys, idx, p0,
+                  want) -> dict:
+    out = {}
+    # gate on ledger replied DELTAS, not just pending==0: the stats
+    # check alone can pass before the io thread has even parsed the
+    # frame (50 ms poll cadence), and the frame rides its own
+    # connection so read-your-writes doesn't order the reads behind it
+    done = svc._slo_snapshot()["replied_total"]
+    with JanusClient("127.0.0.1", port, timeout=120) as c:
+        for k in keys:
+            assert c.request("pnc", k, "s", timeout=120)["response"] != "err"
+        sender = BatchSender("127.0.0.1", port)
+        m = sender.send_frame("pnc", keys, idx, "i", p0=p0)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            st = json.loads(c.request("stats", "_", "g",
+                                      timeout=120)["result"])
+            if (st["types"]["pnc"]["pending_ops"] == 0
+                    and st.get("inbox_depth", 0) == 0
+                    and svc._slo_snapshot()["replied_total"]
+                    >= done + len(keys) + m):
+                break
+            time.sleep(0.05)
+        sender.close()
+        # unsafe increments from the sender's connection become visible
+        # to THIS connection's prospective reads only after delta
+        # propagation across the emulated cluster — poll to convergence
+        # (a routing/priming bug never converges; a propagation delay
+        # does)
+        while time.time() < deadline:
+            out = {k: int(c.request("pnc", k, "gp",
+                                    timeout=120)["result"])
+                   for k in keys}
+            if all(out[k] == want.get(k, 0) for k in keys):
+                break
+            time.sleep(0.1)
+    return out
+
+
+def test_native_demux_state_matches_python_router_and_unsharded(rng):
+    """Randomized keys through three arms — unsharded, Python router,
+    native demux — must agree exactly. The native arm's columns reach
+    the worker pre-routed, so its _ingest_columnar primes (home, key)
+    -> slot fast-slots from ring-drained chunks; a priming bug shows up
+    as a state divergence here."""
+    keys = sorted({f"k{int(rng.integers(1 << 20)):x}" for _ in range(24)})
+    m = 768
+    idx = rng.integers(0, len(keys), m).astype(np.int32)
+    p0 = rng.integers(1, 50, m).astype(np.int64)
+    want = {}
+    for i, a in zip(idx.tolist(), p0.tolist()):
+        want[keys[i]] = want.get(keys[i], 0) + a
+    results = {}
+    for arm, (shards, native) in {
+            "unsharded": (1, True), "pyrouter": (4, False),
+            "native": (4, True)}.items():
+        svc = _mk_service(shards, native)
+        port = svc.start()
+        try:
+            results[arm] = _drive_frames(svc, port, keys, idx, p0, want)
+        finally:
+            svc.stop()
+    for k in keys:
+        assert results["native"][k] == want.get(k, 0), k
+        assert results["native"][k] == results["pyrouter"][k], k
+        assert results["native"][k] == results["unsharded"][k], k
+
+
+# -- t0_ns propagation into the SLO ledger ----------------------------------
+
+def _slo_invariants(snap: dict, base: dict) -> dict:
+    """The run-deterministic part of a merged /slo snapshot (latency
+    buckets vary run to run; counts must not), as DELTAS against a
+    post-start baseline — ledger counters live in the process-wide
+    metrics registry under scope _s{K}, so successive service
+    instances in one test process accumulate into the same counters."""
+    return {
+        "offered": snap["offered"] - base["offered"],
+        "admitted": snap["admitted"] - base["admitted"],
+        "shed": snap["shed"] - base["shed"],
+        "replied_total": snap["replied_total"] - base["replied_total"],
+        "classes": {
+            c: {"replied": v["replied"] - base["classes"][c]["replied"],
+                "e2e_samples": (v["e2e_samples"]
+                                - base["classes"][c]["e2e_samples"])}
+            for c, v in snap["classes"].items()},
+    }
+
+
+def _drive_slo(native: bool, stamped: bool):
+    """4 stamped creates + 96 batched unsafe increments (stamped v2 or
+    unstamped v1 frame) + stamped convergence reads; returns the
+    ledger's invariant counts plus the read count (reads are ledger-
+    visible, so the caller normalizes them out before comparing)."""
+    keys = [f"o{k}" for k in range(4)]
+    m = 96
+    idx = np.asarray([i % 4 for i in range(m)], np.int32)
+    p0 = np.asarray([1 + (i % 5) for i in range(m)], np.int64)
+    svc = _mk_service(2, native)
+    port = svc.start()
+    try:
+        base = svc._slo_snapshot()  # registry counters persist across
+        done = base["replied_total"]  # instances in one process
+        with JanusClient("127.0.0.1", port, timeout=120) as c:
+            for k in keys:
+                assert c.request("pnc", k, "s",
+                                 timeout=120)["response"] != "err"
+            with socket.create_connection(("127.0.0.1", port)) as sk:
+                if stamped:
+                    payload = _v2_frame(1, "pnc", keys, idx, "i", p0,
+                                        t0_ns=time.monotonic_ns())
+                else:
+                    payload = _v1_frame(1, "pnc", keys, idx, "i", p0)
+                sk.sendall(frame0(payload))
+                want = {keys[i]: 0 for i in range(4)}
+                for i, a in zip(idx.tolist(), p0.tolist()):
+                    want[keys[i]] += a
+                # the frame rides its own connection, so read-your-
+                # writes does NOT order the reads behind it — wait for
+                # full ingest (and its acks) before reading
+                deadline = time.time() + 120
+                while time.time() < deadline:
+                    st = json.loads(c.request("stats", "_", "g",
+                                              timeout=120)["result"])
+                    if (st["types"]["pnc"]["pending_ops"] == 0
+                            and st["inbox_depth"] == 0
+                            and svc._slo_snapshot()["replied_total"]
+                            >= done + 4 + m):
+                        break
+                    time.sleep(0.05)
+                # unsafe increments become visible to this connection
+                # only after delta propagation across the emulated
+                # cluster — poll reads to convergence, counting them
+                n_reads = 0
+                while time.time() < deadline:
+                    got = {}
+                    for k in keys:
+                        got[k] = int(c.request("pnc", k, "gp",
+                                               timeout=120)["result"])
+                        n_reads += 1
+                    if got == want:
+                        break
+                    time.sleep(0.1)
+                assert got == want, (got, want)
+        deadline = time.time() + 120
+        snap = svc._slo_snapshot()
+        while (snap["replied_total"] < done + 4 + m + n_reads
+               and time.time() < deadline):
+            time.sleep(0.05)
+            snap = svc._slo_snapshot()
+    finally:
+        svc.stop()
+    out = _slo_invariants(snap, base)
+    assert out["replied_total"] == 4 + m + n_reads
+    return out, n_reads
+
+
+def _minus_reads(inv: dict, n_reads: int) -> dict:
+    """Normalize the convergence reads out of the invariant counts —
+    gp reads are unsafe-class, always stamped, and their number varies
+    with propagation timing."""
+    out = json.loads(json.dumps(inv))
+    out["offered"] -= n_reads
+    out["admitted"] -= n_reads
+    out["replied_total"] -= n_reads
+    out["classes"]["unsafe"]["replied"] -= n_reads
+    out["classes"]["unsafe"]["e2e_samples"] -= n_reads
+    return out
+
+
+@pytest.mark.parametrize("stamped", [True, False],
+                         ids=["v2_stamped", "v1_unstamped"])
+def test_t0_propagation_native_matches_python_router(stamped):
+    via_native, n_nat = _drive_slo(native=True, stamped=stamped)
+    via_python, n_py = _drive_slo(native=False, stamped=stamped)
+    nat, py = _minus_reads(via_native, n_nat), _minus_reads(via_python, n_py)
+    assert nat == py
+    # absolute accounting: creates are safe class (4, stamped); the
+    # frame's 96 unsafe increments sample e2e iff the frame was v2
+    assert nat == {
+        "offered": 4 + 96, "admitted": 4 + 96, "shed": 0,
+        "replied_total": 4 + 96,
+        "classes": {
+            "unsafe": {"replied": 96,
+                       "e2e_samples": 96 if stamped else 0},
+            "safe": {"replied": 4, "e2e_samples": 4},
+            "stable": {"replied": 0, "e2e_samples": 0},
+        },
+    }

@@ -58,6 +58,20 @@ def test_smoke_matrix_all_presets(tmp_path):
     assert ws["arm_sharded"]["shards"] >= 2
     assert ws["arm_unsharded"]["goodput_ops_per_sec"] > 0
     assert ws["arm_sharded"]["goodput_ops_per_sec"] > 0
+    # native demux A/B: the native ring and the Python router replayed
+    # the same schedule at equal shard count and read back bit-equal
+    # state; the native arm's ledger reconciled exactly (run_smoke
+    # gates these too — re-assert the row shape for jsonl consumers)
+    wn = by_run["smoke_wire_sharded_native"]
+    assert wn["states_bitequal"] is True
+    assert wn["arm_pyrouter"]["shards"] >= 2
+    assert wn["arm_native"]["shards"] == wn["arm_pyrouter"]["shards"]
+    assert wn["arm_pyrouter"]["native_demux"] is False
+    assert wn["arm_native"]["native_demux"] is True
+    assert wn["arm_pyrouter"]["goodput_ops_per_sec"] > 0
+    assert wn["arm_native"]["goodput_ops_per_sec"] > 0
+    assert wn["demux_speedup"] > 0
+    assert abs(wn["slo_report"]["replied_vs_total"] - 1.0) <= 0.01
     # flight recorder: tracing was live (events flowed) and cheap
     fl = by_run["smoke_flight_overhead"]["smoke"]
     assert fl["flight_events"] > 0
