@@ -999,6 +999,117 @@ def slo_report(slo0: dict, slo1: dict, goodput_ops_per_sec: float,
     return rep
 
 
+def anatomy_report(slo0: dict, slo1: dict) -> dict:
+    """Fold two /slo snapshots into the latency-anatomy table: per op
+    class, the run-window e2e p50 next to each pipeline segment's p50
+    (wire / ring / inbox / device_step / reply), both recomputed from
+    BUCKET-COUNT deltas so warmup never dilutes the window. Coverage is
+    reported two ways: ``coverage_p50`` = sum of segment p50s over the
+    e2e p50 (the smoke gate's >=0.95 check — quantization makes it
+    overshoot, which the one-sided gate tolerates) and ``coverage_ns``
+    = accounted segment nanoseconds over total e2e nanoseconds (exact
+    sums, so it shows true unattributed time)."""
+    from janus_tpu.obs.metrics import percentile_from_counts
+    from janus_tpu.obs.slo import OP_CLASSES, SEGMENTS
+
+    def _delta(a: list, b: list) -> List[int]:
+        return [int(y) - int(x) for x, y in
+                zip(list(a) + [0] * (len(b) - len(a)), b)]
+
+    rep: Dict[str, object] = {
+        "unstamped": int(slo1.get("unstamped", 0))
+        - int(slo0.get("unstamped", 0)),
+        "untraced": int(slo1.get("untraced", 0))
+        - int(slo0.get("untraced", 0)),
+    }
+    for c in OP_CLASSES:
+        c0 = (slo0.get("classes") or {}).get(c) or {}
+        c1 = (slo1.get("classes") or {}).get(c) or {}
+        n = int(c1.get("e2e_samples", 0)) - int(c0.get("e2e_samples", 0))
+        if n <= 0:
+            continue
+        dc = _delta(c0.get("counts") or [], c1.get("counts") or [])
+        e2e_p50_ns = percentile_from_counts(dc, 0.50)
+        e2e_ns = (int(c1.get("e2e_sum_ns", 0))
+                  - int(c0.get("e2e_sum_ns", 0)))
+        segs: Dict[str, dict] = {}
+        seg_p50_sum = 0.0
+        seg_ns = 0
+        for s in SEGMENTS:
+            s0 = (c0.get("segments") or {}).get(s) or {}
+            s1 = (c1.get("segments") or {}).get(s) or {}
+            sn = (int(s1.get("samples", 0)) - int(s0.get("samples", 0)))
+            if sn <= 0:
+                continue
+            ds = _delta(s0.get("counts") or [], s1.get("counts") or [])
+            p50 = percentile_from_counts(ds, 0.50)
+            dsum = int(s1.get("sum_ns", 0)) - int(s0.get("sum_ns", 0))
+            seg_ns += dsum
+            # a segment sampled on only part of the class (safe creates
+            # skip inbox/device_step) contributes its p50 weighted by
+            # how often it actually occurred, else rare-but-slow legs
+            # of a subpopulation would double-count against the class
+            # median
+            seg_p50_sum += p50 * min(1.0, sn / n)
+            segs[s] = {"samples": sn,
+                       "p50_ms": round(p50 / 1e6, 3),
+                       "mean_ms": round(dsum / sn / 1e6, 3)}
+        rep[c] = {
+            "e2e_samples": n,
+            "e2e_p50_ms": round(e2e_p50_ns / 1e6, 3),
+            "segments": segs,
+            "seg_p50_sum_ms": round(seg_p50_sum / 1e6, 3),
+            "coverage_p50": round(seg_p50_sum / max(e2e_p50_ns, 1), 4),
+            "coverage_ns": round(seg_ns / max(e2e_ns, 1), 4),
+        }
+    return rep
+
+
+def _print_anatomy(rows: List[dict]) -> None:
+    from janus_tpu.obs.slo import OP_CLASSES, SEGMENTS
+    for r in rows:
+        an = r["anatomy"]
+        print(f"== {r['config']} ({r['run']}) — latency anatomy ==")
+        head = "   class        n   e2e p50 | " + " ".join(
+            f"{s:>11}" for s in SEGMENTS) + " |  cover(p50)  cover(ns)"
+        print(head)
+        for c in OP_CLASSES:
+            d = an.get(c)
+            if not d:
+                continue
+            cells = []
+            for s in SEGMENTS:
+                sd = d["segments"].get(s)
+                cells.append(f"{sd['p50_ms']:>11.3f}" if sd
+                             else f"{'-':>11}")
+            print(f"  {c:>7} {d['e2e_samples']:>8,} "
+                  f"{d['e2e_p50_ms']:>9.3f} | " + " ".join(cells)
+                  + f" | {d['coverage_p50']:>10.2%} "
+                  f"{d['coverage_ns']:>9.2%}")
+        print(f"  unstamped {an.get('unstamped', 0)}  "
+              f"untraced {an.get('untraced', 0)}")
+
+
+def fold_anatomy_reports(path: str) -> List[dict]:
+    """Collect latency-anatomy rows from a results_*.jsonl file, one
+    per run that recorded ``anatomy`` (wire_sharded arms)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            an = row.get("anatomy")
+            if not an:
+                continue
+            out.append({"config": row.get("config", "?"),
+                        "run": row.get("run", row.get("mode", "?")),
+                        "ts": row.get("ts"),
+                        "anatomy": an})
+    return out
+
+
 def fold_slo_reports(path: str) -> List[dict]:
     """Collect the SLO report rows from a results_*.jsonl file: one
     entry per run that recorded ``slo_report`` (wire_sharded arms),
@@ -1184,6 +1295,7 @@ def _wire_sharded_arm(cfg: BenchConfig, shards: int,
         arm["elapsed_s"] = round(t_done - t0, 3)
         arm["slo_report"] = slo_report(
             slo0, slo1, arm["goodput_ops_per_sec"], total)
+        arm["anatomy"] = anatomy_report(slo0, slo1)
         # obs-plane cost: endpoint handler CPU + scraper thread CPU over
         # the run's wall time — the analytical goodput-perturbation bound
         cpu_frac = ((http_cpu1 - http_cpu0) + scraper.cpu_ns) \
@@ -1277,13 +1389,14 @@ def run_wire_sharded_native(cfg: BenchConfig) -> Results:
         f"  native demux:  {arm_nat['finals'][:8]}...\n"
         f"  expected:      {expect_l[:8]}...")
     res.extra["states_bitequal"] = True
-    drop = {"finals", "slo_report", "oob"}
+    drop = {"finals", "slo_report", "oob", "anatomy"}
     res.extra["arm_pyrouter"] = {k: v for k, v in arm_py.items()
                                  if k not in drop}
     res.extra["arm_native"] = {k: v for k, v in arm_nat.items()
                                if k not in drop}
     res.extra["slo_report"] = arm_nat.get("slo_report")
     res.extra["slo_report_pyrouter"] = arm_py.get("slo_report")
+    res.extra["anatomy"] = arm_nat.get("anatomy")
     res.extra["oob"] = arm_nat.get("oob")
     res.extra["demux_speedup"] = round(
         arm_nat["goodput_ops_per_sec"]
@@ -1318,7 +1431,7 @@ def run_wire_sharded(cfg: BenchConfig) -> Results:
         f"  sharded:   {arm_b['finals'][:8]}...\n"
         f"  expected:  {expect_l[:8]}...")
     res.extra["states_bitequal"] = True
-    drop = {"finals", "slo_report", "oob"}
+    drop = {"finals", "slo_report", "oob", "anatomy"}
     res.extra["arm_unsharded"] = {k: v for k, v in arm_a.items()
                                   if k not in drop}
     res.extra["arm_sharded"] = {k: v for k, v in arm_b.items()
@@ -1327,6 +1440,7 @@ def run_wire_sharded(cfg: BenchConfig) -> Results:
     # headline observability row (fold_slo_reports picks these up)
     res.extra["slo_report"] = arm_b.get("slo_report")
     res.extra["oob"] = arm_b.get("oob")
+    res.extra["anatomy"] = arm_b.get("anatomy")
     res.extra["shard_speedup"] = round(
         arm_b["goodput_ops_per_sec"]
         / max(arm_a["goodput_ops_per_sec"], 1e-9), 3)
@@ -1719,6 +1833,11 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--slo-report", metavar="PATH",
                     help="print the per-class SLO tables recorded in a "
                          "results_*.jsonl file and exit (no run)")
+    ap.add_argument("--anatomy", metavar="PATH",
+                    help="print the latency-anatomy segment tables "
+                         "(wire/ring/inbox/device_step/reply p50 per op "
+                         "class + e2e coverage) recorded in a "
+                         "results_*.jsonl file and exit (no run)")
     args = ap.parse_args(argv)
     if args.slo_report:
         rows = fold_slo_reports(args.slo_report)
@@ -1726,6 +1845,13 @@ def main(argv: Optional[List[str]] = None) -> None:
             print(f"# no slo_report rows in {args.slo_report}")
         else:
             _print_slo_reports(rows)
+        return
+    if args.anatomy:
+        rows = fold_anatomy_reports(args.anatomy)
+        if not rows:
+            print(f"# no anatomy rows in {args.anatomy}")
+        else:
+            _print_anatomy(rows)
         return
     if args.config:
         cfg = BenchConfig.from_json(open(args.config).read())

@@ -77,13 +77,20 @@ int janus_server_register_type(JanusServer* s, const char* type_code,
  * interned (shared value table) and returned as ids with bit 62 set.
  * t0_ns: the client's CLOCK_MONOTONIC send stamp (ClientMessage field
  * 10 / batch-frame v2 header), 0 when the client didn't stamp — the
- * service's SLO ledger turns it into e2e latency at reply time. */
+ * service's SLO ledger turns it into e2e latency at reply time.
+ * t_ring_ns: the server's own CLOCK_MONOTONIC stamp taken at queue/
+ * ring enqueue on the io thread — always set, so the service can split
+ * e2e latency into wire (t_ring - t0) and ring (drain - t_ring)
+ * segments. trace_id: the frame's compact wire trace context
+ * (batch-frame v3 header), 0 for v1/v2 frames and per-op messages
+ * (counted as untraced by the service). */
 int janus_server_poll_batch(JanusServer* s, int cap,
                             int32_t* type_id, int32_t* key_slot,
                             int32_t* op_code, uint8_t* is_safe,
                             int64_t* p0, int64_t* p1, int64_t* p2,
                             uint64_t* client_tag, int32_t* n_params,
-                            int64_t* t0_ns);
+                            int64_t* t0_ns, int64_t* t_ring_ns,
+                            uint64_t* trace_id);
 
 /* Number of distinct keys seen for a type (key_slot ids are dense). */
 int janus_server_key_count(JanusServer* s, int type_id);
@@ -108,16 +115,18 @@ int janus_server_set_shards(JanusServer* s, int num_shards);
 int janus_server_pin_type_router(JanusServer* s, int type_id, int pinned);
 
 /* Drain up to `cap` ops from ONE shard's ring; same columns (including
- * t0_ns, so the per-shard SLO ledgers keep measuring e2e latency) and
- * semantics as janus_server_poll_batch. Each shard worker calls this
- * with its own shard id + its own buffers; drains are independent.
+ * t0_ns/t_ring_ns/trace_id, so the per-shard SLO ledgers keep measuring
+ * e2e latency and its segments) and semantics as
+ * janus_server_poll_batch. Each shard worker calls this with its own
+ * shard id + its own buffers; drains are independent.
  * Returns count, or -1 for an out-of-range shard. */
 int janus_server_poll_batch_shard(JanusServer* s, int shard, int cap,
                                   int32_t* type_id, int32_t* key_slot,
                                   int32_t* op_code, uint8_t* is_safe,
                                   int64_t* p0, int64_t* p1, int64_t* p2,
                                   uint64_t* client_tag, int32_t* n_params,
-                                  int64_t* t0_ns);
+                                  int64_t* t0_ns, int64_t* t_ring_ns,
+                                  uint64_t* trace_id);
 
 /* Ring observability: current depth / high-watermark of one shard's
  * ring (feeds the shard{K}_inbox_hwm gauge), and the router queue's
@@ -162,13 +171,15 @@ int janus_server_arm_combine_slots(JanusServer* s, int type_id, int home,
 /* Pop ONE combined block from a shard's block queue into caller
  * buffers. Returns 1 (block written: n_lanes/n_tags set, lanes in
  * lane_op/lane_slot/lane_amount, absorbed tags in tags, the frame's
- * shared send stamp in *t0_ns), 0 (queue empty), -1 (bad shard), or
- * -2 (buffers too small — required sizes written to n_lanes/n_tags,
- * block left queued; retry with bigger buffers). */
+ * shared send stamp in *t0_ns, its ring-enqueue stamp in *t_ring_ns
+ * and its wire trace context in *trace_id), 0 (queue empty), -1 (bad
+ * shard), or -2 (buffers too small — required sizes written to
+ * n_lanes/n_tags, block left queued; retry with bigger buffers). */
 int janus_server_poll_combined_shard(JanusServer* s, int shard,
                                      int max_lanes, int max_tags,
                                      int32_t* type_id, int32_t* home,
-                                     int64_t* t0_ns, int32_t* lane_op,
+                                     int64_t* t0_ns, int64_t* t_ring_ns,
+                                     uint64_t* trace_id, int32_t* lane_op,
                                      int32_t* lane_slot,
                                      int64_t* lane_amount,
                                      int32_t* n_lanes, int32_t* n_tags,
@@ -198,6 +209,22 @@ int janus_server_reply_batch(JanusServer* s, int n, const uint64_t* tags,
  * grouping as janus_server_reply_batch. Returns replies delivered. */
 int janus_server_reply_bulk(JanusServer* s, int n, const uint64_t* tags,
                             int ok, const char* response);
+
+/* ---- io-stage stats (the native half of the latency anatomy) ----
+ * Fixed-layout vector of io-stage counters. shard == -1 returns the
+ * GLOBAL view: [0] batch-frame decode ns (io thread wall), [1] frames
+ * decoded, [2] per-op protobuf decode ns, [3] messages decoded,
+ * [4] reply-serialize ns (caller-thread wall over frame builds),
+ * [5] replies serialized, [9..72] router-queue drain-residency counts
+ * in power-of-two ns buckets (bucket 0 = <=0, bucket i = [2^(i-1),
+ * 2^i)). shard >= 0 returns that ring's view: [6] ops ever enqueued,
+ * [7] combined blocks produced, [8] ops absorbed into combined blocks,
+ * [9..72] ring drain-residency buckets. Unused slots are zero. Returns
+ * JANUS_IO_STATS_LEN entries written, -1 for a bad shard id, or -2
+ * when cap < JANUS_IO_STATS_LEN. */
+#define JANUS_IO_STATS_LEN 73
+int janus_server_io_stats(JanusServer* s, int shard, uint64_t* out,
+                          int cap);
 
 /* Counters for observability (PerfCounter analog, Utlis/PerfCounter.cs). */
 long long janus_server_ops_received(JanusServer* s);

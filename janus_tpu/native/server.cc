@@ -33,6 +33,8 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <time.h>
+
 #include <atomic>
 #include <cstring>
 #include <deque>
@@ -47,6 +49,23 @@ namespace {
 
 constexpr uint64_t kInternBit = 1ull << 62;
 
+// Same clock the Python side reads as time.monotonic_ns(): CLOCK_MONOTONIC
+// is system-wide on Linux, so the service can subtract a native stamp from
+// a Python-side now() — the basis of the ring-residency segment.
+int64_t mono_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000000000ll + ts.tv_nsec;
+}
+
+// Power-of-two residency bucket, mirroring the Python registry's
+// Histogram: bucket 0 holds <= 0, bucket i (1..63) holds [2^(i-1), 2^i).
+int residency_bucket(int64_t v) {
+  if (v <= 0) return 0;
+  int idx = 64 - __builtin_clzll(uint64_t(v));  // == bit_length(v)
+  return idx < 63 ? idx : 63;
+}
+
 struct Op {
   int32_t type_id;
   int32_t key_slot;
@@ -56,6 +75,8 @@ struct Op {
   int64_t p[3];
   uint64_t client_tag;
   int64_t t0_ns;  // client send stamp (field 10 / batch header); 0 = none
+  int64_t t_ring_ns;  // CLOCK_MONOTONIC stamp at ring/queue enqueue
+  uint64_t trace_id;  // wire trace context (batch-frame v3); 0 = untraced
 };
 
 struct Conn {
@@ -107,6 +128,8 @@ struct CombinedBlock {
   int32_t type_id;
   int32_t home;
   int64_t t0_ns;
+  int64_t t_ring_ns = 0;  // enqueue stamp shared by every absorbed op
+  uint64_t trace_id = 0;  // frame's wire trace context (v3); 0 = untraced
   std::vector<int32_t> lane_op, lane_slot;
   std::vector<int64_t> lane_amount;
   std::vector<uint64_t> tags;
@@ -125,6 +148,14 @@ struct ShardRing {
   // depth/hwm the inbox gauges report must keep counting wire ops
   long long depth_ops = 0;
   long long hwm = 0;  // high-watermark of depth_ops
+  // io-stage counters (guarded by mu: updated at splice/drain, which
+  // already hold it): ops ever enqueued, combined blocks produced and
+  // ops absorbed into them, and ring-residency (drain - enqueue) ns in
+  // the registry's power-of-two buckets
+  long long enq_ops = 0;
+  long long combine_blocks = 0;
+  long long combine_absorbed = 0;
+  unsigned long long residency[64] = {};
 };
 
 int put_varint(uint64_t v, std::vector<uint8_t>& out) {
@@ -230,6 +261,16 @@ struct JanusServer {
   std::vector<std::string> value_names;             // id -> param string
   std::atomic<long long> ops_in{0}, replies_out{0};
 
+  // io-stage counters: decode wall time on the io thread (batch frames
+  // vs per-op protobufs separately) and reply-serialize wall time on
+  // the caller threads. Atomics: written by the io thread / reply
+  // callers, read by any thread via janus_server_io_stats.
+  std::atomic<long long> frame_decode_ns{0}, frames_decoded{0};
+  std::atomic<long long> msg_decode_ns{0}, msgs_decoded{0};
+  std::atomic<long long> reply_serialize_ns{0}, replies_serialized{0};
+  // router-queue residency buckets (guarded by mu, like the queue)
+  unsigned long long router_residency[64] = {};
+
   // shard demux: 0 = disabled (all ops land on `queue`, the seed
   // behavior); N > 1 = data ops route straight to rings[shard] at
   // decode time, off the GIL, keyed by the intern-time shard cache.
@@ -275,8 +316,12 @@ struct JanusServer {
       std::lock_guard<std::mutex> lk(r.mu);
       r.ops.insert(r.ops.end(), per_shard[s].begin(), per_shard[s].end());
       r.depth_ops += static_cast<long long>(per_shard[s].size());
+      r.enq_ops += static_cast<long long>(per_shard[s].size());
       if (blk) {
         r.depth_ops += static_cast<long long>(blk->tags.size());
+        r.enq_ops += static_cast<long long>(blk->tags.size());
+        r.combine_blocks++;
+        r.combine_absorbed += static_cast<long long>(blk->tags.size());
         r.blocks.push_back(std::move(*blk));
       }
       if (r.depth_ops > r.hwm) r.hwm = r.depth_ops;
@@ -303,11 +348,14 @@ int64_t le64s(const uint8_t* p) { int64_t v; memcpy(&v, p, 8); return v; }
 // bulk-appended to the op queue without per-op protobuf parse or key
 // hashing. Layout after the field-0 length prefix:
 //   u8   magic = 0x00 (invalid as a protobuf tag: field 0 is illegal)
-//   u8   version = 1 or 2
+//   u8   version = 1, 2 or 3
 //   u8   tc_len;  bytes type_code
 //   u32  seq0     (op i's seq = seq0 + i; client bumps its seq by M)
 //   i64  t0_ns    (version >= 2 only: client CLOCK_MONOTONIC send stamp
 //                  shared by every op in the frame; v1 frames -> 0)
+//   u64  trace_id (version >= 3 only: compact wire trace context shared
+//                  by every op in the frame; v1/v2 frames -> 0, which
+//                  the service counts as untraced)
 //   u16  n_keys;  n_keys x { u16 len; bytes name }  (frame-local dict)
 //   u32  M
 //   i32  key_idx[M]   (index into the frame's key dict)
@@ -315,12 +363,14 @@ int64_t le64s(const uint8_t* p) { int64_t v; memcpy(&v, p, 8); return v; }
 //   u8   is_safe[M]
 //   i64  p0[M]
 void JanusServer::handle_batch(uint32_t cid, const uint8_t* p, int len) {
+  const int64_t t_decode0 = mono_ns();
   const uint8_t* end = p + len;
-  if (len < 3 || (p[1] != 1 && p[1] != 2)) return;  // magic checked by caller
+  if (len < 3 || p[1] < 1 || p[1] > 3) return;  // magic checked by caller
   const int ver = p[1];
   int tc_len = p[2];
   p += 3;
-  if (p + tc_len + 4 + (ver >= 2 ? 8 : 0) + 2 > end) return;
+  if (p + tc_len + 4 + (ver >= 2 ? 8 : 0) + (ver >= 3 ? 8 : 0) + 2 > end)
+    return;
   std::string tc(reinterpret_cast<const char*>(p), size_t(tc_len));
   p += tc_len;
   uint32_t seq0 = le32(p);
@@ -328,6 +378,11 @@ void JanusServer::handle_batch(uint32_t cid, const uint8_t* p, int len) {
   int64_t t0_ns = 0;
   if (ver >= 2) {
     t0_ns = le64s(p);
+    p += 8;
+  }
+  uint64_t trace_id = 0;
+  if (ver >= 3) {
+    memcpy(&trace_id, p, 8);
     p += 8;
   }
   int n_keys = le16(p);
@@ -389,6 +444,14 @@ void JanusServer::handle_batch(uint32_t cid, const uint8_t* p, int len) {
     const uint8_t* oc = ki + size_t(m) * 4;
     const uint8_t* sf = oc + m;
     const uint8_t* pp = sf + m;
+    // ring-enqueue stamp, shared by the frame (the per-op staging loop
+    // below is sub-microsecond; one clock read per frame, not per op)
+    const int64_t t_ring = mono_ns();
+    if (armed)
+      for (auto& b : blocks) {
+        b.t_ring_ns = t_ring;
+        b.trace_id = trace_id;
+      }
     for (uint32_t i = 0; i < m; i++) {
       int32_t kidx = le32s(ki + size_t(i) * 4);
       if (kidx < 0 || kidx >= n_keys) continue;
@@ -427,6 +490,8 @@ void JanusServer::handle_batch(uint32_t cid, const uint8_t* p, int len) {
       op.n_params = 1;
       op.p[0] = p0;
       op.t0_ns = t0_ns;
+      op.t_ring_ns = t_ring;
+      op.trace_id = trace_id;
       op.client_tag = tag;
       if (demux)
         staged[size_t(shard_of_slot[size_t(kidx)])].push_back(op);
@@ -437,9 +502,13 @@ void JanusServer::handle_batch(uint32_t cid, const uint8_t* p, int len) {
     if (demux) push_sharded(staged, armed ? &blocks : nullptr);
   }
   if (appended) ops_in.fetch_add(appended, std::memory_order_relaxed);
+  frame_decode_ns.fetch_add(mono_ns() - t_decode0,
+                            std::memory_order_relaxed);
+  frames_decoded.fetch_add(1, std::memory_order_relaxed);
 }
 
 void JanusServer::handle_payload(uint32_t cid, const uint8_t* p, int len) {
+  const int64_t t_decode0 = mono_ns();
   Parsed m;
   if (!parse_client_message(p, len, &m)) return;
   Op op{};
@@ -479,6 +548,7 @@ void JanusServer::handle_payload(uint32_t cid, const uint8_t* p, int len) {
         op.p[i] = int64_t(uint64_t(vid) | kInternBit);
       }
     }
+    op.t_ring_ns = mono_ns();
     if (num_shards > 1 && !ts.pin_router) {
       // slow-path data op: same shard cache as the batch frames, so a
       // per-op client's ops land on the same worker as its frames
@@ -486,12 +556,15 @@ void JanusServer::handle_payload(uint32_t cid, const uint8_t* p, int len) {
       std::lock_guard<std::mutex> rk(r.mu);
       r.ops.push_back(op);
       r.depth_ops++;
+      r.enq_ops++;
       if (r.depth_ops > r.hwm) r.hwm = r.depth_ops;
     } else {
       queue.push_back(op);
     }
   }
   ops_in.fetch_add(1, std::memory_order_relaxed);
+  msg_decode_ns.fetch_add(mono_ns() - t_decode0, std::memory_order_relaxed);
+  msgs_decoded.fetch_add(1, std::memory_order_relaxed);
 }
 
 void JanusServer::io_loop() {
@@ -631,8 +704,11 @@ extern "C" int janus_server_poll_batch(JanusServer* s, int cap,
                                        int32_t* op_code, uint8_t* is_safe,
                                        int64_t* p0, int64_t* p1, int64_t* p2,
                                        uint64_t* client_tag,
-                                       int32_t* n_params, int64_t* t0_ns) {
+                                       int32_t* n_params, int64_t* t0_ns,
+                                       int64_t* t_ring_ns,
+                                       uint64_t* trace_id) {
   std::lock_guard<std::mutex> lk(s->mu);
+  const int64_t now = s->queue.empty() ? 0 : mono_ns();
   int n = 0;
   while (n < cap && !s->queue.empty()) {
     const Op& op = s->queue.front();
@@ -646,6 +722,9 @@ extern "C" int janus_server_poll_batch(JanusServer* s, int cap,
     client_tag[n] = op.client_tag;
     n_params[n] = op.n_params;
     t0_ns[n] = op.t0_ns;
+    t_ring_ns[n] = op.t_ring_ns;
+    trace_id[n] = op.trace_id;
+    s->router_residency[residency_bucket(now - op.t_ring_ns)]++;
     s->queue.pop_front();
     n++;
   }
@@ -685,7 +764,8 @@ extern "C" int janus_server_pin_type_router(JanusServer* s, int type_id,
 extern "C" int janus_server_poll_batch_shard(
     JanusServer* s, int shard, int cap, int32_t* type_id, int32_t* key_slot,
     int32_t* op_code, uint8_t* is_safe, int64_t* p0, int64_t* p1, int64_t* p2,
-    uint64_t* client_tag, int32_t* n_params, int64_t* t0_ns) {
+    uint64_t* client_tag, int32_t* n_params, int64_t* t0_ns,
+    int64_t* t_ring_ns, uint64_t* trace_id) {
   ShardRing* r;
   {
     std::lock_guard<std::mutex> lk(s->mu);
@@ -693,6 +773,7 @@ extern "C" int janus_server_poll_batch_shard(
     r = s->rings[size_t(shard)].get();
   }
   std::lock_guard<std::mutex> rk(r->mu);
+  const int64_t now = r->ops.empty() ? 0 : mono_ns();
   int n = 0;
   while (n < cap && !r->ops.empty()) {
     const Op& op = r->ops.front();
@@ -706,6 +787,9 @@ extern "C" int janus_server_poll_batch_shard(
     client_tag[n] = op.client_tag;
     n_params[n] = op.n_params;
     t0_ns[n] = op.t0_ns;
+    t_ring_ns[n] = op.t_ring_ns;
+    trace_id[n] = op.trace_id;
+    r->residency[residency_bucket(now - op.t_ring_ns)]++;
     r->ops.pop_front();
     n++;
   }
@@ -755,8 +839,9 @@ extern "C" int janus_server_arm_combine_slots(JanusServer* s, int type_id,
 
 extern "C" int janus_server_poll_combined_shard(
     JanusServer* s, int shard, int max_lanes, int max_tags, int32_t* type_id,
-    int32_t* home, int64_t* t0_ns, int32_t* lane_op, int32_t* lane_slot,
-    int64_t* lane_amount, int32_t* n_lanes, int32_t* n_tags, uint64_t* tags) {
+    int32_t* home, int64_t* t0_ns, int64_t* t_ring_ns, uint64_t* trace_id,
+    int32_t* lane_op, int32_t* lane_slot, int64_t* lane_amount,
+    int32_t* n_lanes, int32_t* n_tags, uint64_t* tags) {
   ShardRing* r;
   {
     std::lock_guard<std::mutex> lk(s->mu);
@@ -773,6 +858,10 @@ extern "C" int janus_server_poll_combined_shard(
   *type_id = b.type_id;
   *home = b.home;
   *t0_ns = b.t0_ns;
+  *t_ring_ns = b.t_ring_ns;
+  *trace_id = b.trace_id;
+  r->residency[residency_bucket(mono_ns() - b.t_ring_ns)] +=
+      static_cast<unsigned long long>(b.tags.size());
   memcpy(lane_op, b.lane_op.data(), b.lane_op.size() * sizeof(int32_t));
   memcpy(lane_slot, b.lane_slot.data(), b.lane_slot.size() * sizeof(int32_t));
   memcpy(lane_amount, b.lane_amount.data(),
@@ -893,10 +982,13 @@ bool send_to_conn(JanusServer* s, uint32_t cid,
 
 extern "C" int janus_server_reply(JanusServer* s, uint64_t client_tag, int ok,
                                   const char* response) {
+  const int64_t t0 = mono_ns();
   std::vector<uint8_t> bytes;
   size_t rl = response ? strlen(response) : 0;
   append_reply_frame(client_tag, ok,
                      reinterpret_cast<const uint8_t*>(response), rl, bytes);
+  s->reply_serialize_ns.fetch_add(mono_ns() - t0, std::memory_order_relaxed);
+  s->replies_serialized.fetch_add(1, std::memory_order_relaxed);
   if (!send_to_conn(s, uint32_t(client_tag >> 32), bytes)) return -2;
   s->replies_out.fetch_add(1, std::memory_order_relaxed);
   return 0;
@@ -909,6 +1001,7 @@ extern "C" int janus_server_reply_batch(JanusServer* s, int n,
                                         const int32_t* response_off) {
   // group frames per connection IN ORDER (TCP preserves our append
   // order per connection, so a client's replies arrive in step order)
+  const int64_t t0 = mono_ns();
   std::unordered_map<uint32_t, std::vector<uint8_t>> per_conn;
   std::unordered_map<uint32_t, int> counts;
   for (int i = 0; i < n; i++) {
@@ -918,6 +1011,8 @@ extern "C" int janus_server_reply_batch(JanusServer* s, int n,
                        per_conn[cid]);
     counts[cid]++;
   }
+  s->reply_serialize_ns.fetch_add(mono_ns() - t0, std::memory_order_relaxed);
+  s->replies_serialized.fetch_add(n, std::memory_order_relaxed);
   int sent = 0;
   for (auto& [cid, bytes] : per_conn)
     if (send_to_conn(s, cid, bytes)) sent += counts[cid];
@@ -930,6 +1025,7 @@ extern "C" int janus_server_reply_bulk(JanusServer* s, int n,
                                        const char* response) {
   // one shared status/text for every tag (the unsafe-ack storm), same
   // per-connection grouping + ordered append as reply_batch
+  const int64_t t0 = mono_ns();
   size_t rl = response ? strlen(response) : 0;
   const uint8_t* resp = reinterpret_cast<const uint8_t*>(response);
   std::unordered_map<uint32_t, std::vector<uint8_t>> per_conn;
@@ -939,11 +1035,44 @@ extern "C" int janus_server_reply_bulk(JanusServer* s, int n,
     append_reply_frame(tags[i], ok, resp, rl, per_conn[cid]);
     counts[cid]++;
   }
+  s->reply_serialize_ns.fetch_add(mono_ns() - t0, std::memory_order_relaxed);
+  s->replies_serialized.fetch_add(n, std::memory_order_relaxed);
   int sent = 0;
   for (auto& [cid, bytes] : per_conn)
     if (send_to_conn(s, cid, bytes)) sent += counts[cid];
   s->replies_out.fetch_add(sent, std::memory_order_relaxed);
   return sent;
+}
+
+extern "C" int janus_server_io_stats(JanusServer* s, int shard,
+                                     uint64_t* out, int cap) {
+  if (cap < JANUS_IO_STATS_LEN) return -2;
+  memset(out, 0, size_t(JANUS_IO_STATS_LEN) * sizeof(uint64_t));
+  if (shard < 0) {
+    // global view: io-thread decode + reply-serialize wall time, plus
+    // the router queue's drain residency (the undemuxed/front path)
+    out[0] = uint64_t(s->frame_decode_ns.load(std::memory_order_relaxed));
+    out[1] = uint64_t(s->frames_decoded.load(std::memory_order_relaxed));
+    out[2] = uint64_t(s->msg_decode_ns.load(std::memory_order_relaxed));
+    out[3] = uint64_t(s->msgs_decoded.load(std::memory_order_relaxed));
+    out[4] = uint64_t(s->reply_serialize_ns.load(std::memory_order_relaxed));
+    out[5] = uint64_t(s->replies_serialized.load(std::memory_order_relaxed));
+    std::lock_guard<std::mutex> lk(s->mu);
+    memcpy(out + 9, s->router_residency, sizeof s->router_residency);
+    return JANUS_IO_STATS_LEN;
+  }
+  ShardRing* r;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    if (shard >= int(s->rings.size())) return -1;
+    r = s->rings[size_t(shard)].get();
+  }
+  std::lock_guard<std::mutex> rk(r->mu);
+  out[6] = uint64_t(r->enq_ops);
+  out[7] = uint64_t(r->combine_blocks);
+  out[8] = uint64_t(r->combine_absorbed);
+  memcpy(out + 9, r->residency, sizeof r->residency);
+  return JANUS_IO_STATS_LEN;
 }
 
 extern "C" long long janus_server_ops_received(JanusServer* s) {

@@ -69,7 +69,7 @@ def load() -> ctypes.CDLL:
         lib.janus_server_register_type.restype = c.c_int
         lib.janus_server_poll_batch.argtypes = [
             c.c_void_p, c.c_int, i32p, i32p, i32p, u8p, i64p, i64p, i64p,
-            u64p, i32p, i64p,
+            u64p, i32p, i64p, i64p, u64p,
         ]
         lib.janus_server_poll_batch.restype = c.c_int
         lib.janus_shard_of.argtypes = [c.c_char_p, c.c_char_p, c.c_int]
@@ -81,7 +81,7 @@ def load() -> ctypes.CDLL:
         lib.janus_server_pin_type_router.restype = c.c_int
         lib.janus_server_poll_batch_shard.argtypes = [
             c.c_void_p, c.c_int, c.c_int, i32p, i32p, i32p, u8p, i64p, i64p,
-            i64p, u64p, i32p, i64p,
+            i64p, u64p, i32p, i64p, i64p, u64p,
         ]
         lib.janus_server_poll_batch_shard.restype = c.c_int
         lib.janus_server_set_homes.argtypes = [c.c_void_p, i32p, c.c_int]
@@ -93,10 +93,13 @@ def load() -> ctypes.CDLL:
             c.c_void_p, c.c_int, c.c_int, i32p, c.c_int]
         lib.janus_server_arm_combine_slots.restype = c.c_int
         lib.janus_server_poll_combined_shard.argtypes = [
-            c.c_void_p, c.c_int, c.c_int, c.c_int, i32p, i32p, i64p, i32p,
-            i32p, i64p, i32p, i32p, u64p,
+            c.c_void_p, c.c_int, c.c_int, c.c_int, i32p, i32p, i64p, i64p,
+            u64p, i32p, i32p, i64p, i32p, i32p, u64p,
         ]
         lib.janus_server_poll_combined_shard.restype = c.c_int
+        lib.janus_server_io_stats.argtypes = [c.c_void_p, c.c_int, u64p,
+                                              c.c_int]
+        lib.janus_server_io_stats.restype = c.c_int
         lib.janus_server_shard_depth.argtypes = [c.c_void_p, c.c_int]
         lib.janus_server_shard_depth.restype = c.c_longlong
         lib.janus_server_shard_hwm.argtypes = [c.c_void_p, c.c_int]
@@ -234,11 +237,13 @@ class NativeServer:
         """Drain up to ``cap`` parsed ops. Returns a dict of numpy arrays
         (length = actual count): type_id, key_slot, op_code, is_safe,
         p0..p2, client_tag, n_params, t0_ns (client send stamp; 0 when
-        the client didn't stamp).
+        the client didn't stamp), t_ring_ns (the io thread's monotonic
+        enqueue stamp — always set) and trace_id (batch-frame v3 wire
+        trace context; 0 = untraced).
 
         The returned arrays are VIEWS into per-server buffers reused by
         the next poll_batch call — consume (or copy) them before polling
-        again. The service's step loop does; allocating ~9 cap-sized
+        again. The service's step loop does; allocating ~11 cap-sized
         arrays per step churned MBs/step at large caps."""
         c = ctypes
         if self._poll_bufs is None or cap > self._poll_cap:
@@ -253,6 +258,8 @@ class NativeServer:
                 "client_tag": np.empty(cap, np.uint64),
                 "n_params": np.empty(cap, np.int32),
                 "t0_ns": np.empty(cap, np.int64),
+                "t_ring_ns": np.empty(cap, np.int64),
+                "trace_id": np.empty(cap, np.uint64),
             }
             self._poll_cap = cap
         b = self._poll_bufs
@@ -267,6 +274,7 @@ class NativeServer:
             ptr(b["p0"], c.c_int64), ptr(b["p1"], c.c_int64),
             ptr(b["p2"], c.c_int64), ptr(b["client_tag"], c.c_uint64),
             ptr(b["n_params"], c.c_int32), ptr(b["t0_ns"], c.c_int64),
+            ptr(b["t_ring_ns"], c.c_int64), ptr(b["trace_id"], c.c_uint64),
         )
         return {f: v[:n] for f, v in b.items()}
 
@@ -308,6 +316,8 @@ class NativeServer:
                 "client_tag": np.empty(cap, np.uint64),
                 "n_params": np.empty(cap, np.int32),
                 "t0_ns": np.empty(cap, np.int64),
+                "t_ring_ns": np.empty(cap, np.int64),
+                "trace_id": np.empty(cap, np.uint64),
             }
             entry = (bufs, cap)
             self._shard_bufs[shard] = entry
@@ -323,6 +333,7 @@ class NativeServer:
             ptr(b["p0"], c.c_int64), ptr(b["p1"], c.c_int64),
             ptr(b["p2"], c.c_int64), ptr(b["client_tag"], c.c_uint64),
             ptr(b["n_params"], c.c_int32), ptr(b["t0_ns"], c.c_int64),
+            ptr(b["t_ring_ns"], c.c_int64), ptr(b["trace_id"], c.c_uint64),
         )
         if n < 0:
             raise RuntimeError(f"poll_batch_shard: bad shard {shard}")
@@ -364,9 +375,10 @@ class NativeServer:
     def poll_combined_shard(self, shard: int):
         """Pop ONE combined counter block from a shard's block queue.
         Returns None when the queue is empty, else a dict with type_id,
-        home, t0_ns (python ints), lane_op/lane_slot (int32), lane_amount
-        (int64) and tags (uint64) — OWNED copies, safe to hold across
-        further polls. Grows the reuse buffers on -2 and retries."""
+        home, t0_ns, t_ring_ns, trace_id (python ints), lane_op/lane_slot
+        (int32), lane_amount (int64) and tags (uint64) — OWNED copies,
+        safe to hold across further polls. Grows the reuse buffers on -2
+        and retries."""
         c = ctypes
         entry = self._comb_bufs.get(shard)
         if entry is None:
@@ -379,6 +391,8 @@ class NativeServer:
             self._comb_bufs[shard] = entry
         tid_o, home_o = c.c_int32(0), c.c_int32(0)
         t0 = c.c_int64(0)
+        t_ring = c.c_int64(0)
+        trace = c.c_uint64(0)
         nl = c.c_int32(0)
         nt = c.c_int32(0)
 
@@ -390,6 +404,7 @@ class NativeServer:
                 self._h, shard,
                 len(entry["lane_op"]), len(entry["tags"]),
                 c.byref(tid_o), c.byref(home_o), c.byref(t0),
+                c.byref(t_ring), c.byref(trace),
                 ptr(entry["lane_op"], c.c_int32),
                 ptr(entry["lane_slot"], c.c_int32),
                 ptr(entry["lane_amount"], c.c_int64),
@@ -402,6 +417,8 @@ class NativeServer:
                 return {
                     "type_id": int(tid_o.value), "home": int(home_o.value),
                     "t0_ns": int(t0.value),
+                    "t_ring_ns": int(t_ring.value),
+                    "trace_id": int(trace.value),
                     "lane_op": entry["lane_op"][:n_lanes].copy(),
                     "lane_slot": entry["lane_slot"][:n_lanes].copy(),
                     "lane_amount": entry["lane_amount"][:n_lanes].copy(),
@@ -417,6 +434,34 @@ class NativeServer:
                             entry[f].dtype)
                 continue
             raise RuntimeError(f"poll_combined_shard: bad shard {shard}")
+
+    # keep in sync with JANUS_IO_STATS_LEN / the layout doc in
+    # janus_native.h (9 scalars + 64 residency buckets)
+    _IO_STATS_LEN = 73
+    _IO_STAT_SCALARS = (
+        "frame_decode_ns", "frames_decoded", "msg_decode_ns",
+        "msgs_decoded", "reply_serialize_ns", "replies_serialized",
+        "enq_ops", "combine_blocks", "combine_absorbed",
+    )
+
+    def io_stats(self, shard: int = -1) -> dict:
+        """Native io-stage counters. ``shard=-1`` = the global view
+        (frame/message decode ns on the io thread, reply-serialize ns,
+        router-queue residency buckets); ``shard>=0`` = that ring's view
+        (ops enqueued, combiner blocks/absorbed ops, ring-residency
+        buckets). ``residency`` is a 64-entry power-of-two ns bucket
+        vector matching the Python registry's Histogram bucketing."""
+        out = np.zeros(self._IO_STATS_LEN, np.uint64)
+        rc = self._lib.janus_server_io_stats(
+            self._h, shard,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            self._IO_STATS_LEN)
+        if rc < 0:
+            raise RuntimeError(f"io_stats failed ({rc}) for shard {shard}")
+        stats = {name: int(out[i])
+                 for i, name in enumerate(self._IO_STAT_SCALARS)}
+        stats["residency"] = [int(v) for v in out[9:]]
+        return stats
 
     def shard_depth(self, shard: int) -> int:
         return int(self._lib.janus_server_shard_depth(self._h, shard))

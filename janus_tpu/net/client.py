@@ -14,6 +14,8 @@ has exactly the reference's safe-update semantics
 """
 from __future__ import annotations
 
+import itertools
+import os
 import socket
 import struct
 import threading
@@ -21,6 +23,20 @@ import time
 from typing import Dict, Iterable, Optional, Sequence
 
 import numpy as np
+
+# per-process sender nonce: combined with the pid and the frame's seq0 it
+# makes every frame's wire trace id unique across a split cluster's
+# client processes without any coordination
+_SENDER_IDS = itertools.count(1)
+
+
+def make_trace_id(sender_id: int, seq0: int) -> int:
+    """Compact (u64) wire trace id for one batch frame: pid (24 bits) |
+    per-process sender nonce (8 bits) | seq0 (32 bits). Nonzero by
+    construction (sender ids start at 1), so a traced frame can never
+    alias the v1/v2 "untraced" sentinel 0."""
+    return (((os.getpid() & 0xFFFFFF) << 40)
+            | ((sender_id & 0xFF) << 32) | (seq0 & 0xFFFFFFFF))
 
 
 def _varint(v: int) -> bytes:
@@ -95,23 +111,28 @@ def frame0(payload: bytes) -> bytes:
 def encode_batch_frame(seq0: int, type_code: str, keys: Sequence[str],
                        key_idx: np.ndarray, op_codes: np.ndarray,
                        is_safe: np.ndarray, p0: np.ndarray,
-                       t0_ns: int = 0) -> bytes:
+                       t0_ns: int = 0, trace_id: int = 0) -> bytes:
     """One columnar batch-frame payload (server.cc handle_batch layout):
     M same-type single-letter update ops as packed little-endian numpy
     columns. Op i's wire sequence is ``seq0 + i``. The column bytes are
     ``.tobytes()`` of the caller's arrays — no per-op encode loop, which
     is what lets a Python client offer >1M ops/s. ``t0_ns`` rides the
-    version-2 frame header once for the whole frame (every op in a frame
-    shares one send instant); the server still accepts v1 frames, whose
-    ops count as unstamped."""
+    version >= 2 frame header once for the whole frame (every op in a
+    frame shares one send instant). ``trace_id`` is the compact wire
+    trace context carried by the version-3 header — nonzero upgrades the
+    frame to v3 and threads the id through the native ring into the
+    service's flight recorder; 0 emits a v2 frame (the server still
+    accepts v1/v2, whose ops count as unstamped/untraced)."""
     tc = type_code.encode()
     head = bytearray()
     head.append(0x00)            # magic: invalid as a protobuf tag
-    head.append(2)               # version (2 = header carries t0_ns)
+    head.append(3 if trace_id else 2)  # version (3 = header + trace_id)
     head.append(len(tc))
     head.extend(tc)
     head.extend(struct.pack("<I", seq0 & 0xFFFFFFFF))
     head.extend(struct.pack("<q", t0_ns))
+    if trace_id:
+        head.extend(struct.pack("<Q", trace_id & 0xFFFFFFFFFFFFFFFF))
     head.extend(struct.pack("<H", len(keys)))
     for k in keys:
         kb = k.encode()
@@ -164,6 +185,7 @@ class JanusClient:
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.timeout = timeout
+        self._sender_id = next(_SENDER_IDS)
         self._seq = 0
         self._lock = threading.Lock()
         # sends serialize on their own lock: sendall blocking on a full
@@ -273,7 +295,9 @@ class JanusClient:
                 self._safe_seqs.add(seq0 + int(i))
         payload = encode_batch_frame(seq0, type_code, keys, key_idx,
                                      op_codes, safe, p0,
-                                     t0_ns=time.monotonic_ns())
+                                     t0_ns=time.monotonic_ns(),
+                                     trace_id=make_trace_id(
+                                         self._sender_id, seq0))
         with self._send_lock:
             self.sock.sendall(frame0(payload))
         return range(seq0, seq0 + m)
@@ -387,6 +411,7 @@ class BatchSender:
     def __init__(self, host: str, port: int, timeout: float = 60.0):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sender_id = next(_SENDER_IDS)
         self._seq = 0
         self._closed = False
         self.reply_bytes = 0
@@ -418,7 +443,9 @@ class BatchSender:
         self._seq += m
         payload = encode_batch_frame(seq0, type_code, keys, key_idx,
                                      op_codes, safe, p0,
-                                     t0_ns=time.monotonic_ns())
+                                     t0_ns=time.monotonic_ns(),
+                                     trace_id=make_trace_id(
+                                         self._sender_id, seq0))
         self.sock.sendall(frame0(payload))
         return m
 

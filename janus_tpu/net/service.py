@@ -136,6 +136,12 @@ class JanusConfig:
     # where anomaly-triggered flight-recorder dumps land ("" -> never
     # write files; the recorder itself is enabled via obs.flight.enable)
     flight_dump_dir: str = ""
+    # enable the process-wide flight recorder at service construction —
+    # the config-file path to causal tracing for subprocess-spawned
+    # split/host processes, where no harness code runs to call
+    # obs.flight.enable() first (the merged /trace federation needs
+    # every peer's /flight populated)
+    flight: bool = False
     # out-of-band obs endpoint (obs/httpexp.py): >= 0 starts an HTTP
     # thread serving /metrics /stats /health /slo /trace from the live
     # registry with NO data-plane queueing (0 -> ephemeral port,
@@ -190,6 +196,7 @@ class JanusConfig:
             ingest_wait_ms=float(raw.get("ingest_wait_ms", 10.0)),
             watchdog_stall_ticks=int(raw.get("watchdog_stall_ticks", 200)),
             flight_dump_dir=raw.get("flight_dump_dir", ""),
+            flight=bool(raw.get("flight", False)),
             obs_port=int(raw.get("obs_port", -1)),
             log_level=raw.get("log_level", "info"),
             types=types,
@@ -246,10 +253,11 @@ class _TypeRuntime:
         # not board after a later columnar one — order-sensitive
         # captures like mvr write clocks and orset clears would observe
         # the wrong state):
-        #   ("item", fields, client_tag, safe, create_key) — per-item
-        #     lane; creates carry fields=None
+        #   ("item", fields, client_tag, safe, create_key, t0_ns,
+        #     trace_id) — per-item lane; creates carry fields=None
         #   ("chunk", cols) — a columnar run of update ops (numpy
-        #     arrays op/key/a0/a1/a2/safe/tag), boarded by slice
+        #     arrays op/key/a0/a1/a2/safe/tag/t0, plus trace when the
+        #     frame carried a v3 trace id), boarded by slice
         # The columnar lane exists because the per-item Python dict walk
         # measured ~30us/op and capped the wire plane at ~19k ops/s (the
         # reference burns 24% of CPU in the same dispatch/tracking work,
@@ -259,8 +267,10 @@ class _TypeRuntime:
         # eligibility; filled as slots materialize)
         self.fast_slot = np.full((cfg.num_nodes, tcfg.num_keys), -1,
                                  np.int32)
-        # (slot, node, b) -> (client_tag, t0_ns) for deferred safe acks
-        self.ack_map: Dict[Tuple[int, int, int], Tuple[int, int]] = {}
+        # (slot, node, b) -> (client_tag, t0_ns, t_drain_ns, t_board0_ns,
+        # t_board1_ns) for deferred safe acks + their anatomy segments
+        self.ack_map: Dict[Tuple[int, int, int],
+                           Tuple[int, int, int, int, int]] = {}
         # device-resident zero batch for idle keep-alive rounds (rebuilt
         # host uploads every tick would ride each idle dispatch)
         self.idle_batch = None
@@ -340,8 +350,10 @@ def _combine_lanes(cols: Dict[str, np.ndarray],
               cols["a0"][u].astype(np.int64))
     reps = cols["tag"][u][first]
     reps_t0 = cols["t0"][u][first]
+    tr = cols.get("trace")
+    reps_tr = tr[u][first] if tr is not None else None
     cap = 2**31 - 1  # device lanes are int32; split larger sums
-    ops_l, keys_l, a0_l, tag_l, t0_l = [], [], [], [], []
+    ops_l, keys_l, a0_l, tag_l, t0_l, tr_l = [], [], [], [], [], []
     for i, tot in enumerate(sums.tolist()):
         while True:
             part = min(tot, cap)
@@ -350,13 +362,15 @@ def _combine_lanes(cols: Dict[str, np.ndarray],
             a0_l.append(part)
             tag_l.append(int(reps[i]))
             t0_l.append(int(reps_t0[i]))
+            if reps_tr is not None:
+                tr_l.append(int(reps_tr[i]))
             tot -= part
             if tot <= 0:
                 break
     nc = len(ops_l)
     if len(s_idx) + nc > limit:
         return None
-    return {
+    out = {
         "op": np.concatenate(
             [cols["op"][s_idx], np.asarray(ops_l, np.int32)]),
         "key": np.concatenate(
@@ -374,6 +388,10 @@ def _combine_lanes(cols: Dict[str, np.ndarray],
         "t0": np.concatenate(
             [cols["t0"][s_idx], np.asarray(t0_l, np.int64)]),
     }
+    if tr is not None:
+        out["trace"] = np.concatenate(
+            [tr[s_idx], np.asarray(tr_l, np.uint64)])
+    return out
 
 
 def _merge_combined(a: dict, b: dict, limit: int) -> Optional[dict]:
@@ -384,8 +402,10 @@ def _merge_combined(a: dict, b: dict, limit: int) -> Optional[dict]:
     round — merging keeps 'one consensus round per backlog' true no
     matter how many polls fed it. Returns None if the merged form would
     exceed ``limit`` lanes (callers then queue ``b`` separately)."""
-    cat = {f: np.concatenate([a[f], b[f]])
-           for f in ("op", "key", "a0", "a1", "a2", "safe", "tag", "t0")}
+    fields = ["op", "key", "a0", "a1", "a2", "safe", "tag", "t0"]
+    if "trace" in a and "trace" in b:
+        fields.append("trace")
+    cat = {f: np.concatenate([a[f], b[f]]) for f in fields}
     out = _combine_lanes(cat, limit)
     if out is None:
         return None
@@ -432,7 +452,7 @@ _POLL_FIELDS = (
     ("type_id", np.int32), ("key_slot", np.int32), ("op_code", np.int32),
     ("is_safe", np.uint8), ("n_params", np.int32), ("p0", np.int64),
     ("p1", np.int64), ("p2", np.int64), ("client_tag", np.uint64),
-    ("t0_ns", np.int64),
+    ("t0_ns", np.int64), ("t_ring_ns", np.int64), ("trace_id", np.uint64),
 )
 
 
@@ -612,6 +632,8 @@ class JanusService:
             stall_ticks=cfg.watchdog_stall_ticks,
             dump_dir=cfg.flight_dump_dir or None,
             tag=wd_tag))
+        if cfg.flight:
+            obs_flight.enable()
         self._flight = obs_flight.get_recorder()
         # flight-recorder trace-id prefix: shard workers qualify the
         # per-op c{tag} ids so two shards tracing the same client tag
@@ -673,6 +695,15 @@ class JanusService:
             np.int32)
         self._read_letters = {int(c): l for c, l in zip(
             self._read_opcs.tolist(), ("gp", "gs", "sp", "ss"))}
+        # stable-contract read op codes, for the vectorized class split
+        # the latency-anatomy segments record under (obs/slo.py SEGMENTS)
+        self._stable_opcs = np.asarray(
+            [ord("g") | (ord("s") << 8), ord("s") | (ord("s") << 8)],
+            np.int32)
+        # monotonic stamp of the current step's wire drain: the boundary
+        # between the "ring" segment (native enqueue -> drain) and
+        # everything host-side after it
+        self._t_drain_ns = 0
 
         # -- shard plane -------------------------------------------------
         self._shard_m = None
@@ -997,6 +1028,7 @@ class JanusService:
                 min(65536, max(_POLL_FLOOR,
                                n * self.cfg.ops_per_block)))
             offer_n = len(polled["client_tag"])
+        self._t_drain_ns = time.monotonic_ns()
         count = len(polled["client_tag"])
         slow_idx = None
         reads: List[dict] = []
@@ -1011,6 +1043,7 @@ class JanusService:
                 self.slo.offered.add(offer_n)
             if self._shard_m is not None:
                 self._shard_m["ops_total"].add(count)
+            self._record_wire_ring(polled)
             slow_idx = self._ingest_columnar(polled, reads)
         for j, blk in enumerate(blocks):
             # combined blocks stage AFTER this poll's ring ops (their
@@ -1045,6 +1078,8 @@ class JanusService:
                     "p1": int(polled["p1"][i]),
                     "n_params": int(polled["n_params"][i]),
                     "t0": int(polled["t0_ns"][i]),
+                    "trace": int(polled["trace_id"][i]),
+                    "td": self._t_drain_ns,
                 }, reads, pos=int(i))
         # flush staged queue entries in arrival order (columnar chunks
         # and per-item entries interleave exactly as their ops arrived)
@@ -1064,12 +1099,24 @@ class JanusService:
                 for lst in self._stage.values():
                     for _pos, e in lst:
                         if e[0] == "chunk":
-                            for tg in e[1]["tag"][e[1]["safe"]].tolist():
-                                fl.span_at(f"{pfx}c{int(tg)}", "ingest",
-                                           t0w, t1w)
-                        elif e[3]:  # ("item", fields, tag, safe, ckey, t0)
-                            fl.span_at(f"{pfx}c{int(e[2])}", "ingest",
-                                       t0w, t1w)
+                            ch = e[1]
+                            sf = ch["safe"]
+                            trs = ch.get("trace")
+                            tg_l = ch["tag"][sf].tolist()
+                            tr_l = (trs[sf].tolist() if trs is not None
+                                    else [0] * len(tg_l))
+                            for tg, trc in zip(tg_l, tr_l):
+                                fl.span_at(
+                                    f"x{trc:x}" if trc
+                                    else f"{pfx}c{int(tg)}",
+                                    "ingest", t0w, t1w)
+                        elif e[3]:
+                            # ("item", fields, tag, safe, ckey, t0, trace)
+                            trc = e[6] if len(e) > 6 else 0
+                            fl.span_at(
+                                f"x{trc:x}" if trc
+                                else f"{pfx}c{int(e[2])}",
+                                "ingest", t0w, t1w)
             limit = min(self.cfg.block_floor, self.cfg.ops_per_block)
             for (tid, v), lst in self._stage.items():
                 lst.sort(key=lambda e: e[0])
@@ -1147,9 +1194,14 @@ class JanusService:
                         self._read(rt, slot, home, it["letters"], it), "ok")
             # reply-time SLO sample: stable-frontier reads carry the
             # "stable" contract, prospective reads the local-state one
-            self.slo.observe(
-                "stable" if it["letters"] in ("gs", "ss") else "unsafe",
-                it.get("t0", 0))
+            cls = "stable" if it["letters"] in ("gs", "ss") else "unsafe"
+            self.slo.observe(cls, it.get("t0", 0))
+            # reply segment covers drain -> answer, deferral included —
+            # a read held for read-your-writes pays its wait here
+            td = it.get("td", 0)
+            if td:
+                self.slo.observe_seg(
+                    cls, "reply", time.monotonic_ns() - td, scalar=True)
         self._step_ms.append(1e3 * (time.perf_counter() - t_step))
         if len(self._step_ms) > 10_000:
             del self._step_ms[:5_000]
@@ -1203,7 +1255,7 @@ class JanusService:
             if key not in rt.known_keys:
                 rt.known_keys.add(key)
                 self._stage.setdefault((it["tid"], home), []).append(
-                    (pos, ("item", None, tag, False, key, 0)))
+                    (pos, ("item", None, tag, False, key, 0, 0)))
                 self._pend_inc(tag)
             return
         if key not in rt.known_keys:
@@ -1240,16 +1292,66 @@ class JanusService:
             self._reply(tag, "error: bad param", "err")
             return
         self._stage.setdefault((it["tid"], home), []).append(
-            (pos, ("item", fields, tag, it["safe"], None, it.get("t0", 0))))
+            (pos, ("item", fields, tag, it["safe"], None, it.get("t0", 0),
+                   it.get("trace", 0))))
         self._pend_inc(tag)
         if not it["safe"]:
             # immediate reply for unsafe updates (the op is queued on
             # the home node's next block; ClientInterface.cs:233-242)
             self._reply(tag, "success", "ok")
             self.slo.observe("unsafe", it.get("t0", 0))
+            if self._t_drain_ns:
+                self.slo.observe_seg(
+                    "unsafe", "reply",
+                    time.monotonic_ns() - self._t_drain_ns, scalar=True)
 
     def _conn_has_pending(self, conn_id: int) -> bool:
         return self._conn_pending.get(conn_id, 0) > 0
+
+    def _record_wire_ring(self, polled) -> None:
+        """Drain-time half of the latency anatomy: one vectorized pass
+        records the ``wire`` (client send -> native ring enqueue) and
+        ``ring`` (enqueue -> this drain) segments per op class, counts
+        v1/v2 legacy traffic (unstamped/untraced), and emits one flight
+        ``ring`` span per distinct wire trace id (frame granularity —
+        every op in a batch frame shares its trace id). All stamps are
+        CLOCK_MONOTONIC, system-wide on Linux, so the client's t0, the
+        io thread's t_ring, and this drain subtract exactly."""
+        sl = self.slo
+        t0 = polled["t0_ns"]
+        tr = polled["t_ring_ns"]
+        trace = polled["trace_id"]
+        sl.note_unstamped(int((t0 <= 0).sum()))
+        sl.note_untraced(int((trace == np.uint64(0)).sum()))
+        opc = polled["op_code"]
+        stable_m = np.isin(opc, self._stable_opcs)
+        safe_m = ~stable_m & (polled["is_safe"].astype(bool)
+                              | (opc == np.int32(ord("s"))))
+        td = self._t_drain_ns
+        ringed = tr > 0
+        stamped = t0 > 0
+        for cls, m in (("stable", stable_m), ("safe", safe_m),
+                       ("unsafe", ~stable_m & ~safe_m)):
+            mw = m & ringed & stamped
+            if mw.any():
+                sl.observe_seg(cls, "wire", tr[mw] - t0[mw])
+            mr = m & ringed
+            if mr.any():
+                sl.observe_seg(cls, "ring", td - tr[mr])
+        fl = self._flight
+        if fl.enabled:
+            m = (trace != np.uint64(0)) & ringed
+            if m.any():
+                # monotonic -> wall conversion so ring spans land on the
+                # same clock as every other flight event
+                now_m = time.monotonic_ns()
+                now_w = time.time_ns()
+                utr, idx = np.unique(trace[m], return_index=True)
+                t_r = tr[m][idx]
+                end_w = now_w - (now_m - td)
+                for u, t_r_i in zip(utr.tolist(), t_r.tolist()):
+                    fl.span_at(f"x{u:x}", "ring",
+                               now_w - (now_m - t_r_i), end_w)
 
     def _ingest_columnar(self, polled, reads: List[dict]) -> np.ndarray:
         """Vectorized routing for the hot op class: single-letter UPDATE
@@ -1391,6 +1493,7 @@ class JanusService:
                         "op": o, "key": rslot[run], "a0": a0,
                         "a1": a1, "a2": a2, "safe": safe_f[run],
                         "tag": tags[run], "t0": polled["t0_ns"][run],
+                        "trace": polled["trace_id"][run],
                     }
                     if kind == "pnc":
                         chunk = self._combine_pnc_chunk(
@@ -1413,6 +1516,14 @@ class JanusService:
             # one vectorized SLO sample for the whole bulk ack — this is
             # the ledger's entire cost on the hot columnar path
             self.slo.observe_batch("unsafe", polled["t0_ns"][unsafe])
+            # reply segment: drain -> this ack queueing, shared by every
+            # op in the bulk (they are acked in one native call)
+            if self._t_drain_ns:
+                self.slo.observe_seg(
+                    "unsafe", "reply",
+                    np.full(int(unsafe.sum()),
+                            time.monotonic_ns() - self._t_drain_ns,
+                            np.int64))
         return self._ingest_residual(polled, fast, reads)
 
     def _combine_pnc_chunk(self, cols: Dict[str, np.ndarray],
@@ -1490,6 +1601,38 @@ class JanusService:
         self._ack_bulk.append(tags)
         t0 = blk["t0_ns"]
         self.slo.observe_batch("unsafe", np.full(n, t0, np.int64))
+        # anatomy segments fan out to every absorbed op exactly like the
+        # frame's shared t0 does; the block's t_ring_ns is the io
+        # thread's enqueue stamp
+        t_ring = int(blk.get("t_ring_ns", 0))
+        trace = int(blk.get("trace_id", 0))
+        nowm = time.monotonic_ns()
+        td = self._t_drain_ns or nowm
+        if t0 <= 0:
+            self.slo.note_unstamped(n)
+        if not trace:
+            self.slo.note_untraced(n)
+        if t_ring > 0:
+            if t0 > 0:
+                self.slo.observe_seg(
+                    "unsafe", "wire", np.full(n, t_ring - t0, np.int64))
+            self.slo.observe_seg(
+                "unsafe", "ring", np.full(n, td - t_ring, np.int64))
+        self.slo.observe_seg(
+            "unsafe", "reply", np.full(n, nowm - td, np.int64))
+        fl = self._flight
+        if fl.enabled:
+            # combine span (enqueue -> drain of the combined block) plus
+            # an instant carrying the absorbed-op count, so trace-level
+            # op accounting reconciles with the ledger's replied counter
+            tid_s = (f"x{trace:x}" if trace
+                     else f"{self._trace_pfx}c{int(tags[0])}")
+            now_w = time.time_ns()
+            if t_ring > 0:
+                fl.span_at(tid_s, "combine",
+                           now_w - (nowm - t_ring), now_w - (nowm - td))
+            fl.event(tid_s, "combine_absorbed", "I", detail=int(n),
+                     t_ns=now_w)
         # native slots -> device lanes; armed combos are resolved by
         # construction (armed only after fast_slot was written)
         o = self._fast_ops[tid][blk["lane_op"]]
@@ -1535,6 +1678,7 @@ class JanusService:
                 "safe": np.zeros(nl, bool),
                 "tag": np.full(nl, tags[0], np.uint64),
                 "t0": np.full(nl, t0, np.int64),
+                "trace": np.full(nl, trace, np.uint64),
                 "pend": ((uconn, ucnt) if last else
                          (uconn[:0], ucnt[:0])),
                 "nops": n if last else 0,
@@ -1585,6 +1729,7 @@ class JanusService:
                         "key": key, "p0": int(p0[i]), "p1": int(p1[i]),
                         "n_params": int(npar[i]),
                         "t0": int(polled["t0_ns"][i]),
+                        "td": self._t_drain_ns,
                     })
                 handled[i] = True
             c_idx = np.nonzero(create_m & tm)[0]
@@ -1604,6 +1749,12 @@ class JanusService:
                     # creates carry the safe (consensus-gated) contract
                     # even when answered from the materialized table
                     self.slo.observe_batch("safe", done_t0)
+                    if self._t_drain_ns:
+                        self.slo.observe_seg(
+                            "safe", "reply",
+                            np.full(len(done),
+                                    time.monotonic_ns() - self._t_drain_ns,
+                                    np.int64))
         return np.nonzero(rest & ~handled)[0]
 
     def _op_fields(self, rt: _TypeRuntime, op_id: int, slot: int, home: int,
@@ -1807,7 +1958,7 @@ class JanusService:
                     taken[v].append(("chunk", head))
                     b += take
                     continue
-                _kind, fields, tag, is_safe, create_key, t0 = entry
+                _kind, fields, tag, is_safe, create_key, t0, trc = entry
                 taken[v].append(entry)
                 if fields is not None:
                     for name, val in fields.items():
@@ -1816,7 +1967,7 @@ class JanusService:
                 # host-side (key, block) binding; only its position in
                 # the committed order matters
                 safe[v, b] = is_safe
-                placed[v].append((b, is_safe, tag, create_key, t0))
+                placed[v].append((b, is_safe, tag, create_key, t0, trc))
                 b += 1
         # record only payload-bearing blocks in latency stats; idle
         # keep-alive rounds must not grow host logs or dilute metrics
@@ -1826,34 +1977,43 @@ class JanusService:
 
         # elect one representative trace id per boarding block (safe ops
         # first — they are the traced end-to-end path; every op in the
-        # block shares its consensus fate anyway)
+        # block shares its consensus fate anyway). A wire trace id (v3
+        # batch frames) wins over the synthetic c{tag} label: the x-id
+        # is what the client stamped, so the merged cluster timeline can
+        # correlate this block's seal/commit chain with the sender.
         trace = None
         if self._flight.enabled:
             trace = [None] * n
             for v in range(n):
                 tid_v = None
-                for _b, is_safe, tg, _ck, _t0 in placed[v]:
+                tr_v = 0
+                for _b, is_safe, tg, _ck, _t0, trc in placed[v]:
                     if tid_v is None or is_safe:
-                        tid_v = tg
+                        tid_v, tr_v = tg, trc
                         if is_safe:
                             break
                 if tid_v is None or not any(
-                        s for _b, s, _t, _c, _t0 in placed[v]):
+                        s for _b, s, _t, _c, _t0, _tr in placed[v]):
                     for _b0, head in fast_placed[v]:
+                        trs = head.get("trace")
                         si = np.nonzero(head["safe"])[0]
                         if si.size:
                             tid_v = int(head["tag"][si[0]])
+                            tr_v = int(trs[si[0]]) if trs is not None else 0
                             break
                         if tid_v is None:
                             tid_v = int(head["tag"][0])
+                            tr_v = int(trs[0]) if trs is not None else 0
                 if tid_v is not None:
-                    trace[v] = f"{self._trace_pfx}c{int(tid_v)}"
+                    trace[v] = (f"x{tr_v:x}" if tr_v
+                                else f"{self._trace_pfx}c{int(tid_v)}")
 
         def requeue(v):
             for entry in reversed(taken[v]):
                 rt.pending[v].appendleft(entry)
 
         t_seal = time.perf_counter()
+        tb0 = time.monotonic_ns()
         if rt.node is not None:
             info = rt.node.step(ops, safe=safe, record=record)
             if info is None:  # key exchange incomplete: requeue all
@@ -1862,11 +2022,13 @@ class JanusService:
                 return had_ops
         else:
             info = rt.kv.step(ops, safe=safe, record=record, trace=trace)
+        tb1 = time.monotonic_ns()
         self._sched_update(rt, time.perf_counter() - t_seal)
         accepted, slots = info["accepted"], info["slot"]
+        td = self._t_drain_ns
         for v in range(n):
             if accepted[v]:
-                for b, is_safe, tag, create_key, t0 in placed[v]:
+                for b, is_safe, tag, create_key, t0, _trc in placed[v]:
                     self._pend_dec(tag)
                     if create_key is not None:
                         rnd = int(info["round"][v])
@@ -1878,7 +2040,8 @@ class JanusService:
                             self._fabric.send_create(
                                 rt.index, create_key, rnd, v)
                     if is_safe:
-                        rt.ack_map[(int(slots[v]), v, b)] = (tag, t0)
+                        rt.ack_map[(int(slots[v]), v, b)] = (
+                            tag, t0, td, tb0, tb1)
                 for b0, head in fast_placed[v]:
                     pend = head.get("pend")
                     if pend is not None:
@@ -1896,7 +2059,8 @@ class JanusService:
                     sv = int(slots[v])
                     for i in np.nonzero(head["safe"])[0]:
                         rt.ack_map[(sv, v, b0 + int(i))] = (
-                            int(head["tag"][i]), int(head["t0"][i]))
+                            int(head["tag"][i]), int(head["t0"][i]),
+                            td, tb0, tb1)
             else:
                 # slot sealed/back-pressure: requeue in order for the
                 # next block (the reference re-queues uncertified
@@ -1931,11 +2095,23 @@ class JanusService:
         acks = rt.kv.drain_safe_acks()
         for (s, v, b) in list(rt.ack_map):
             if acks[s, v, b]:
-                tag, t0 = rt.ack_map.pop((s, v, b))
+                tag, t0, td, tb0, tb1 = rt.ack_map.pop((s, v, b))
                 # deferred safe-update ack (NotifySafeUpdateComplete,
                 # ClientInterface.cs:186-190)
                 self._reply(tag, "success", "su")
                 self.slo.observe("safe", t0)
+                # anatomy tail of the safe path: inbox = drain ->
+                # boarding, device_step = the boarded step's seal,
+                # reply = step end -> this ack (consensus commit lag
+                # rides here — it IS the safe contract's cost)
+                now = time.monotonic_ns()
+                if td:
+                    self.slo.observe_seg(
+                        "safe", "inbox", max(0, tb0 - td), scalar=True)
+                self.slo.observe_seg(
+                    "safe", "device_step", tb1 - tb0, scalar=True)
+                self.slo.observe_seg(
+                    "safe", "reply", now - tb1, scalar=True)
 
     def _read(self, rt: _TypeRuntime, slot: int, home: int, letters: str,
               it: dict) -> str:
@@ -2023,6 +2199,25 @@ class JanusService:
                 # offered = ops handed to the shard (admitted is bumped
                 # by the worker when its step loop drains them)
                 w.slo.offered.add(int(m.sum()))
+        fl = self._flight
+        if fl.enabled:
+            # router handoff span per traced frame: native enqueue ->
+            # routed to a shard inbox. The worker's ingest span for the
+            # same x-id starts after this ends, so the merged timeline
+            # shows the router -> shard handoff in causal order.
+            tr = polled["trace_id"]
+            trng = polled["t_ring_ns"]
+            m = tr != np.uint64(0)
+            if m.any():
+                now_m = time.monotonic_ns()
+                now_w = time.time_ns()
+                utr, idx = np.unique(tr[m], return_index=True)
+                t_r = trng[m][idx]
+                for u, t_r_i in zip(utr.tolist(), t_r.tolist()):
+                    fl.span_at(
+                        f"x{u:x}", "route",
+                        now_w - (now_m - t_r_i) if t_r_i > 0 else now_w,
+                        now_w)
         for i in np.nonzero(ctrl)[0].tolist():
             self._ctrl_reply(int(tid_arr[i]),
                              int(polled["client_tag"][i]))
@@ -2196,6 +2391,31 @@ class JanusService:
             reg.gauge(f"svc_{tc}{sfx}_block_size").set(rt.kv.B)
             reg.gauge(f"svc_{tc}{sfx}_pending_ops").set(
                 _pending_total(rt.pending))
+        self._refresh_io_gauges()
+
+    def _refresh_io_gauges(self) -> None:
+        """Native io-plane counters -> registry: global frame/msg decode
+        and reply-serialize costs on server-owning instances, per-shard
+        ring depth/hwm and enqueue/combine counts on shard workers.
+        Cumulative native counters export as gauges set to their current
+        value — the registry is label-free, so names carry the shard
+        scope and federation splices ``node=`` in at merge time."""
+        reg = obs_metrics.get_registry()
+        if self._shard_id is None:
+            io = self.server.io_stats(-1)
+            for f in ("frame_decode_ns", "frames_decoded",
+                      "msg_decode_ns", "msgs_decoded",
+                      "reply_serialize_ns", "replies_serialized"):
+                reg.gauge(f"io_{f}").set(io[f])
+        if self._native_ring:
+            k = self._shard_id
+            reg.gauge(f"shard{k}_ring_depth").set(
+                max(0, int(self.server.shard_depth(k))))
+            reg.gauge(f"shard{k}_ring_hwm").set(
+                int(self.server.shard_hwm(k)))
+            io = self.server.io_stats(k)
+            for f in ("enq_ops", "combine_blocks", "combine_absorbed"):
+                reg.gauge(f"shard{k}_io_{f}").set(io[f])
 
     def _metrics_report(self) -> str:
         """Prometheus text exposition. The front-end refreshes every
@@ -2205,6 +2425,7 @@ class JanusService:
             for w in self.workers:
                 w._refresh_scrape_gauges()
                 w.watchdog.health()  # refresh the watchdog_health gauge
+            self._refresh_io_gauges()  # server-global io-plane counters
         else:
             self._refresh_scrape_gauges()
             self.watchdog.health()
@@ -2227,6 +2448,7 @@ class JanusService:
                 for w in self.workers:
                     w._refresh_host_gauges()
                     w.watchdog.health()  # refresh watchdog_health gauge
+                self._refresh_io_gauges()  # server-global io counters
             else:
                 self._refresh_host_gauges()
                 self.watchdog.health()
@@ -2237,13 +2459,47 @@ class JanusService:
         def _json(fn):
             return lambda: ("application/json", json.dumps(fn()))
 
+        from janus_tpu.obs.httpexp import query_route
+
+        def _capped(q):
+            """Newest-suffix of the flight ring: ``?n=`` caps the dump
+            (the ring holds 64k events; an uncapped Chrome-JSON render
+            of all of them is the single most expensive obs handler)."""
+            ev = self._flight.snapshot()
+            try:
+                cap = int(q.get("n", 0))
+            except (TypeError, ValueError):
+                cap = 0
+            return ev[-cap:] if cap > 0 else ev
+
+        @query_route
+        def _trace(q):
+            # self-accounted like the rest of the obs plane: the render
+            # cost lands on a dedicated counter so the harness can
+            # subtract trace pulls from the <2% overhead budget
+            reg = obs_metrics.get_registry()
+            t0c = time.thread_time_ns()
+            body = chrome_trace_json(_capped(q))
+            reg.counter("obs_trace_cpu_ns").add(
+                time.thread_time_ns() - t0c)
+            return "application/json", body
+
+        @query_route
+        def _flight_dump(q):
+            # raw event dump + the serving wall clock, for federation's
+            # clock-offset estimate (obs/httpexp.py /trace?merged=1)
+            return "application/json", json.dumps(
+                {"now_ns": time.time_ns(),
+                 "total": self._flight.total,
+                 "events": _capped(q)})
+
         return {
             "/metrics": _metrics,
             "/stats": _json(self._stats_oob),
             "/health": _json(self._health_oob),
             "/slo": _json(self._slo_snapshot),
-            "/trace": lambda: ("application/json",
-                               chrome_trace_json(self._flight.snapshot())),
+            "/trace": _trace,
+            "/flight": _flight_dump,
         }
 
     def _slo_snapshot(self) -> dict:

@@ -31,14 +31,24 @@ import json
 import re
 import threading
 import time
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from janus_tpu.obs.metrics import get_registry
 
-# a route: () -> (content_type, body_str)
-Route = Callable[[], Tuple[str, str]]
+# a route: () -> (content_type, body_str). A route function carrying a
+# truthy ``accepts_query`` attribute is instead called with one dict of
+# decoded query params (``query_route`` below sets the attribute).
+Route = Callable[..., Tuple[str, str]]
+
+
+def query_route(fn: Route) -> Route:
+    """Mark a route as wanting the parsed query string: it will be
+    called as ``fn({param: value, ...})`` instead of ``fn()``."""
+    fn.accepts_query = True  # type: ignore[attr-defined]
+    return fn
 
 
 class ObsHttpServer:
@@ -61,12 +71,19 @@ class ObsHttpServer:
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
                 t0 = time.thread_time_ns()
-                fn = table.get(self.path.split("?", 1)[0])
+                path, _, qs = self.path.partition("?")
+                fn = table.get(path)
                 try:
                     if fn is None:
                         code, ctype, body = 404, "text/plain", "not found\n"
                     else:
-                        ctype, body = fn()
+                        if getattr(fn, "accepts_query", False):
+                            q = {k: v[-1] for k, v in
+                                 urllib.parse.parse_qs(
+                                     qs, keep_blank_values=True).items()}
+                            ctype, body = fn(q)
+                        else:
+                            ctype, body = fn()
                         code = 200
                 except Exception as e:  # handler bug must not kill serving
                     c_err.add()
@@ -177,6 +194,7 @@ def federation_routes(peers: Sequence[Tuple[str, str]],
     routes) — a wedged worker host must never wedge the cluster scrape.
     """
     from janus_tpu.obs.slo import merge_slo
+    from janus_tpu.obs.traceview import merged_chrome_trace_json
     from janus_tpu.obs.watchdog import merge_health
 
     def _fan(path: str):
@@ -228,8 +246,43 @@ def federation_routes(peers: Sequence[Tuple[str, str]],
                "nodes": {lb: json.loads(t) for lb, t in good}}
         return "application/json", json.dumps(doc)
 
+    @query_route
+    def _trace(q: Dict[str, str]) -> Tuple[str, str]:
+        # Pull every peer's flight dump and put all of them on one
+        # clock. Each peer's /flight reply carries its own wall-clock
+        # ``now_ns``; the scrape's send/receive stamps bracket when
+        # that clock was read, so offset = midpoint(t_send, t_recv) -
+        # peer_now aligns the peer onto the merging node's clock with
+        # error bounded by rtt/2 (PERF.md records why that bound is
+        # small next to the segment widths it orders).
+        n = q.get("n")
+        path = "/flight" + (f"?n={int(n)}" if n else "")
+        good, up, clock = [], {}, {}
+        for label, base in peers:
+            try:
+                t_send = time.time_ns()
+                text = scrape_text(base.rstrip("/") + path,
+                                   timeout=timeout)
+                t_recv = time.time_ns()
+                doc = json.loads(text)
+                peer_now = int(doc.get("now_ns", 0))
+                off = ((t_send + t_recv) // 2 - peer_now) if peer_now else 0
+                good.append((label, off, doc.get("events", [])))
+                clock[label] = {"offset_ns": off,
+                                "rtt_ns": t_recv - t_send}
+                up[label] = True
+            except Exception:
+                up[label] = False
+        if q.get("merged"):
+            return "application/json", merged_chrome_trace_json(
+                [(lb, off, [tuple(e) for e in evs])
+                 for lb, off, evs in good])
+        doc = {"up": up, "clock": clock,
+               "nodes": {lb: evs for lb, _off, evs in good}}
+        return "application/json", json.dumps(doc)
+
     return {"/metrics": _metrics, "/slo": _slo, "/health": _health,
-            "/stats": _stats}
+            "/stats": _stats, "/trace": _trace}
 
 
 def main(argv: Optional[List[str]] = None) -> None:

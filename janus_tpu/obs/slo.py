@@ -46,6 +46,20 @@ from janus_tpu.obs.metrics import (NUM_BUCKETS, Histogram, Registry,
 # stable: stable-frontier reads (gs/ss)
 OP_CLASSES = ("unsafe", "safe", "stable")
 
+# e2e latency anatomy: the ordered segments a stamped op's end-to-end
+# latency decomposes into. Every segment is measured from REAL per-op
+# timestamps (client t0_ns, the native io thread's ring-enqueue stamp
+# t_ring_ns, the worker's drain/step/ack instants), so per op the
+# recorded segments sum exactly to the recorded e2e — which is what the
+# smoke gate's >=95%-coverage assertion leans on.
+#   wire:        client send -> native ring enqueue (TCP + io decode)
+#   ring:        native ring enqueue -> worker drain
+#   inbox:       worker drain -> the op's block boarding a device step
+#                (safe ops only; unsafe/stable never wait for a step)
+#   device_step: the device step that sealed/committed the op's block
+#   reply:       step (or drain, for classes that skip it) -> ack send
+SEGMENTS = ("wire", "ring", "inbox", "device_step", "reply")
+
 
 def classify(letters: str, is_safe: bool) -> str:
     """Map a wire op code + safe flag to its SLO class."""
@@ -78,6 +92,16 @@ class SloLedger:
             c: reg.counter(f"slo{scope}_replied_{c}_total")
             for c in OP_CLASSES
         }
+        # latency anatomy: per-class segment histograms + stamping
+        # coverage counters (ops that carried no t0 / no wire trace id —
+        # v1/v2 frames, per-op ClientMessages, native loadgen)
+        self.seg: Dict[str, Dict[str, Histogram]] = {
+            c: {s: reg.histogram(f"slo{scope}_seg_{s}_{c}_ns")
+                for s in SEGMENTS}
+            for c in OP_CLASSES
+        }
+        self.unstamped = reg.counter(f"slo{scope}_unstamped_total")
+        self.untraced = reg.counter(f"slo{scope}_untraced_total")
 
     # -- reply-time sampling --------------------------------------------
 
@@ -112,20 +136,65 @@ class SloLedger:
         now = time.monotonic_ns() if now_ns is None else now_ns
         self.e2e[cls].record_many(now - t0[stamped])
 
+    # -- segment sampling -----------------------------------------------
+
+    def observe_seg(self, cls: str, seg: str, values,
+                    scalar: bool = False) -> None:
+        """Record one latency-anatomy segment for a batch of ops of one
+        class. ``values`` is an int64 ns array (or a scalar when
+        ``scalar``); non-positive entries still record (clamped to 0 by
+        the histogram) so segment sample counts stay reconcilable with
+        the e2e sample counts they decompose."""
+        h = self.seg[cls][seg]
+        if scalar:
+            h.record(int(values))
+        else:
+            h.record_many(values)
+
+    def note_unstamped(self, n: int = 1) -> None:
+        if n > 0:
+            self.unstamped.add(n)
+
+    def note_untraced(self, n: int = 1) -> None:
+        if n > 0:
+            self.untraced.add(n)
+
     # -- exposition ------------------------------------------------------
 
     def snapshot(self) -> dict:
         """JSON-shaped view for the ``/slo`` endpoint. Includes the raw
-        64-bucket count vectors so ``merge_slo`` can recompute merged
-        percentiles instead of averaging per-shard ones."""
+        64-bucket count vectors (e2e AND per-segment) so ``merge_slo``
+        can recompute merged percentiles instead of averaging per-shard
+        ones. Per-segment ``sum_ns`` is exact (the histogram tracks the
+        raw sum), so segment-coverage checks have a bucketing-free
+        denominator when they want one."""
         classes = {}
         for c, h in self.e2e.items():
+            segs = {}
+            for s, sh in self.seg[c].items():
+                # segments that never sampled are omitted entirely: a
+                # 3-class x 5-segment x 64-bucket grid of zeros triples
+                # the /slo payload (and the scrape CPU billed to
+                # obs_http_cpu_ns) for information the reader infers
+                # from absence. Consumers (merge_slo, anatomy_report)
+                # already treat a missing segment as zero.
+                if sh.count == 0:
+                    continue
+                segs[s] = {
+                    "samples": sh.count,
+                    "p50_ms": round(sh.percentile(0.50) / 1e6, 3),
+                    "p99_ms": round(sh.percentile(0.99) / 1e6, 3),
+                    "sum_ns": int(sh.sum),
+                    "counts": sh.counts(),
+                }
             classes[c] = {
                 "replied": int(self.replied[c].value),
                 "e2e_samples": h.count,
                 "e2e_p50_ms": round(h.percentile(0.50) / 1e6, 3),
                 "e2e_p99_ms": round(h.percentile(0.99) / 1e6, 3),
+                "e2e_sum_ns": int(h.sum),
                 "counts": h.counts(),
+                "segments": segs,
             }
         return {
             "scope": self.scope,
@@ -133,6 +202,8 @@ class SloLedger:
             "offered": int(self.offered.value),
             "admitted": int(self.admitted.value),
             "shed": int(self.shed.value),
+            "unstamped": int(self.unstamped.value),
+            "untraced": int(self.untraced.value),
             "replied_total": sum(int(self.replied[c].value)
                                  for c in OP_CLASSES),
         }
@@ -152,28 +223,45 @@ def merge_slo(parts: List[Tuple[str, dict]], scope: str = "") -> dict:
     ``nodes[host].scope`` naming the host whose fold it is. A merged
     snapshot is itself a valid ``parts`` input (same keys + counts)."""
     counts = {c: [0] * NUM_BUCKETS for c in OP_CLASSES}
-    classes = {c: {"replied": 0, "e2e_samples": 0} for c in OP_CLASSES}
+    seg_counts = {c: {s: [0] * NUM_BUCKETS for s in SEGMENTS}
+                  for c in OP_CLASSES}
+    seg_meta = {c: {s: {"samples": 0, "sum_ns": 0} for s in SEGMENTS}
+                for c in OP_CLASSES}
+    classes = {c: {"replied": 0, "e2e_samples": 0, "e2e_sum_ns": 0}
+               for c in OP_CLASSES}
     out = {"scope": scope, "offered": 0, "admitted": 0, "shed": 0,
-           "replied_total": 0, "nodes": {}}
+           "unstamped": 0, "untraced": 0, "replied_total": 0, "nodes": {}}
     for label, snap in parts:
-        for k in ("offered", "admitted", "shed", "replied_total"):
+        for k in ("offered", "admitted", "shed", "unstamped", "untraced",
+                  "replied_total"):
             out[k] += int(snap.get(k, 0))
         for c in OP_CLASSES:
             cs = (snap.get("classes") or {}).get(c) or {}
             classes[c]["replied"] += int(cs.get("replied", 0))
             classes[c]["e2e_samples"] += int(cs.get("e2e_samples", 0))
+            classes[c]["e2e_sum_ns"] += int(cs.get("e2e_sum_ns", 0))
             vec = cs.get("counts")
             if vec:
                 acc = counts[c]
                 for i, v in enumerate(vec[:NUM_BUCKETS]):
                     acc[i] += int(v)
+            for s, ss in (cs.get("segments") or {}).items():
+                if s not in SEGMENTS:
+                    continue
+                seg_meta[c][s]["samples"] += int(ss.get("samples", 0))
+                seg_meta[c][s]["sum_ns"] += int(ss.get("sum_ns", 0))
+                svec = ss.get("counts")
+                if svec:
+                    acc = seg_counts[c][s]
+                    for i, v in enumerate(svec[:NUM_BUCKETS]):
+                        acc[i] += int(v)
         out["nodes"][label] = {
             "scope": str(snap.get("scope", "") or label),
             "classes": {
                 c: {k: v
                     for k, v in ((snap.get("classes") or {})
                                  .get(c, {})).items()
-                    if k != "counts"}
+                    if k not in ("counts", "segments")}
                 for c in OP_CLASSES
             },
             "offered": int(snap.get("offered", 0)),
@@ -186,5 +274,21 @@ def merge_slo(parts: List[Tuple[str, dict]], scope: str = "") -> dict:
         classes[c]["e2e_p99_ms"] = round(
             percentile_from_counts(counts[c], 0.99) / 1e6, 3)
         classes[c]["counts"] = counts[c]
+        segs = {}
+        for s in SEGMENTS:
+            # mirror the leaf snapshot: all-zero segments stay out of
+            # the merged payload too (merge-of-merges keeps the trim)
+            if seg_meta[c][s]["samples"] == 0:
+                continue
+            segs[s] = {
+                "samples": seg_meta[c][s]["samples"],
+                "sum_ns": seg_meta[c][s]["sum_ns"],
+                "p50_ms": round(
+                    percentile_from_counts(seg_counts[c][s], 0.50) / 1e6, 3),
+                "p99_ms": round(
+                    percentile_from_counts(seg_counts[c][s], 0.99) / 1e6, 3),
+                "counts": seg_counts[c][s],
+            }
+        classes[c]["segments"] = segs
     out["classes"] = classes
     return out

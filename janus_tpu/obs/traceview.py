@@ -64,6 +64,48 @@ def write_chrome_trace(path: str, recorder: FlightRecorder) -> int:
     return len(events)
 
 
+def merged_chrome_trace_events(
+        nodes: Iterable[tuple]) -> List[dict]:
+    """Fold several nodes' flight snapshots onto ONE timeline.
+
+    ``nodes`` is an iterable of ``(label, offset_ns, events)`` where
+    ``offset_ns`` is the estimated clock offset of that node relative
+    to the merging node (added to every timestamp, so after shifting
+    all nodes share the merger's wall clock). Each node becomes its own
+    Perfetto *process* (``pid``) named by ``process_name`` metadata;
+    trace-id lanes stay per-node threads, so a cross-process op appears
+    as same-named lanes under two process tracks at aligned times.
+    """
+    out: List[dict] = []
+    pid = 0
+    for label, offset_ns, events in nodes:
+        pid += 1
+        out.append({"ph": "M", "name": "process_name", "pid": pid,
+                    "tid": 0, "args": {"name": str(label)}})
+        tids: Dict[str, int] = {}
+        for t_ns, trace_id, span, kind, detail in events:
+            tid = tids.get(trace_id)
+            if tid is None:
+                tid = tids[trace_id] = len(tids) + 1
+                out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                            "tid": tid, "args": {"name": trace_id}})
+            ts = (t_ns + offset_ns) / 1e3
+            if kind == "S":
+                out.append({"ph": "X", "name": span, "cat": "janus",
+                            "pid": pid, "tid": tid, "ts": ts,
+                            "dur": max(0.001, int(detail or 0) / 1e3)})
+            else:
+                out.append({"ph": "i", "name": span, "cat": "janus",
+                            "pid": pid, "tid": tid, "ts": ts, "s": "t",
+                            "args": {"detail": detail}})
+    return out
+
+
+def merged_chrome_trace_json(nodes: Iterable[tuple]) -> str:
+    return json.dumps({"traceEvents": merged_chrome_trace_events(nodes),
+                       "displayTimeUnit": "ms"})
+
+
 def span_chains(events: Iterable[Event]) -> Dict[str, List[str]]:
     """trace_id -> ordered span names (``"S"`` events only), a helper
     for tests asserting the full pipeline chain exists under one id."""

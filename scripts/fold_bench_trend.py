@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Fold the repo's per-round bench artifacts into ONE perf-trend table.
+
+Each growth round leaves two kinds of evidence at the repo root:
+``BENCH_rNN.json`` (the driver's bench.py capture: one headline metric
+plus optional consensus / fastpath-isolation sub-blocks) and
+``results_rN.jsonl`` (harness matrix rows: wire/sharded runs with
+goodput, multihost scale-out rows with aggregate goodput). Reading a
+trend across rounds means opening a dozen files with three different
+schemas — this script folds them into one markdown table, newest round
+last, so a perf regression shows up as a column going the wrong way
+between two adjacent rows.
+
+    python scripts/fold_bench_trend.py                 # repo root -> stdout
+    python scripts/fold_bench_trend.py --root . --out PERF_TREND.md
+
+Columns are best-effort per round: a round that never ran a given
+bench (no multihost row, no consensus block) renders ``-`` rather than
+dropping the row, so gaps in coverage stay visible too.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+from typing import Dict, List, Optional
+
+
+def _round_of(path: str) -> Optional[int]:
+    m = re.search(r"_r0*(\d+)\.(?:json|jsonl)$", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def fold_trend(root: str) -> Dict[int, dict]:
+    """round number -> folded row dict. BENCH and results files for the
+    same round merge into one row; unknown/broken files are skipped
+    (a half-written artifact must not hide the rounds around it)."""
+    rows: Dict[int, dict] = {}
+
+    def _row(rnd: int) -> dict:
+        return rows.setdefault(rnd, {"round": rnd})
+
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        rnd = _round_of(path)
+        if rnd is None:
+            continue
+        try:
+            doc = json.loads(open(path).read())
+        except (OSError, ValueError):
+            continue
+        row = _row(rnd)
+        parsed = doc.get("parsed") or {}
+        if "value" in parsed:
+            row["fastpath_ops_per_sec"] = float(parsed["value"])
+            row["fastpath_metric"] = parsed.get("metric", "?")
+        if "vs_baseline" in parsed:
+            row["vs_baseline"] = float(parsed["vs_baseline"])
+        cons = parsed.get("consensus") or {}
+        if cons:
+            row["safe_ops_per_sec"] = float(
+                cons.get("safe_ops_per_sec", 0.0))
+            row["safe_p50_ms"] = float(cons.get("p50_ms", 0.0))
+        colo = parsed.get("consensus_colocated") or {}
+        if colo:
+            row["safe_colocated_p50_ms"] = float(colo.get("p50_ms", 0.0))
+
+    for path in glob.glob(os.path.join(root, "results_r*.jsonl")):
+        rnd = _round_of(path)
+        if rnd is None:
+            continue
+        wire_best = multi_best = None
+        try:
+            lines = open(path).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            mode = r.get("mode") or ""
+            tput = r.get("throughput_ops_per_sec")
+            if mode.startswith("wire") and tput:
+                wire_best = max(wire_best or 0.0, float(tput))
+            agg = r.get("aggregate_goodput_ops_per_sec")
+            if agg:
+                multi_best = max(multi_best or 0.0, float(agg))
+        row = _row(rnd)
+        if wire_best is not None:
+            row["wire_goodput_ops_per_sec"] = wire_best
+        if multi_best is not None:
+            row["multihost_goodput_ops_per_sec"] = multi_best
+    return rows
+
+
+_COLUMNS = (
+    ("fastpath_ops_per_sec", "fastpath ops/s", "{:,.0f}"),
+    ("vs_baseline", "vs baseline", "x{:.1f}"),
+    ("safe_ops_per_sec", "safe ops/s", "{:,.0f}"),
+    ("safe_p50_ms", "safe p50 ms", "{:.1f}"),
+    ("safe_colocated_p50_ms", "colocated p50 ms", "{:.2f}"),
+    ("wire_goodput_ops_per_sec", "wire goodput ops/s", "{:,.0f}"),
+    ("multihost_goodput_ops_per_sec", "multihost ops/s", "{:,.0f}"),
+)
+
+
+def render_markdown(rows: Dict[int, dict]) -> str:
+    """Fold rows -> one GitHub-markdown trend table, oldest round first."""
+    out: List[str] = ["# Bench trend", ""]
+    if not rows:
+        out.append("_no BENCH_r*.json or results_r*.jsonl artifacts found_")
+        return "\n".join(out) + "\n"
+    metrics = {r.get("fastpath_metric") for r in rows.values()
+               if r.get("fastpath_metric")}
+    if metrics:
+        out.append(f"Headline metric: `{', '.join(sorted(metrics))}`")
+        out.append("")
+    keep = [(k, h, f) for k, h, f in _COLUMNS
+            if any(k in r for r in rows.values())]
+    out.append("| round | " + " | ".join(h for _k, h, _f in keep) + " |")
+    out.append("|---" * (len(keep) + 1) + "|")
+    for rnd in sorted(rows):
+        r = rows[rnd]
+        cells = [f.format(r[k]) if k in r else "-" for k, _h, f in keep]
+        out.append(f"| r{rnd:02d} | " + " | ".join(cells) + " |")
+    return "\n".join(out) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json / results_r*.jsonl "
+             "(default: the repo root)")
+    ap.add_argument("--out", metavar="PATH",
+                    help="write the markdown here instead of stdout")
+    args = ap.parse_args(argv)
+    text = render_markdown(fold_trend(args.root))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"# trend table -> {args.out}")
+    else:
+        print(text, end="")
+
+
+if __name__ == "__main__":
+    main()

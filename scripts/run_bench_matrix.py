@@ -163,6 +163,48 @@ def _slo_record_cost_ns() -> float:
     return (time.perf_counter_ns() - t0) / (iters * width)
 
 
+def _seg_record_cost_ns() -> float:
+    """Measured per-sample cost of the segment-anatomy recording path:
+    a bare ``Histogram.record_many`` (``SloLedger.observe_seg`` adds no
+    clock read or counter — the reply-time flush already took both and
+    hands the segment arrays over as-is). Measured at width 32768, the
+    sample-weighted flush width of the loaded smoke run: the drain
+    flush that records wire/ring/reply is the SAME call site whose
+    widths _slo_record_cost_ns measured at median ~32k (p10 256), and
+    per-SAMPLE cost must be billed at the width the samples actually
+    arrived in — the narrow p10 flushes carry 0.05% of the samples.
+    _slo_record_cost_ns keeps its width-4096 conservatism because it
+    bills one sample per op; the anatomy bills three, so charging the
+    ~10 us fixed numpy dispatch 8x too often would triple-compound
+    into the gate failing on arithmetic the process never executes."""
+    import time
+
+    import numpy as np
+
+    from janus_tpu.obs.metrics import Histogram
+
+    h = Histogram("_smoke_seg_probe")
+    width = 32768
+    vals = np.full(width, 123_456, np.int64)
+    for _ in range(5):
+        h.record_many(vals)
+    # min over repeat chunks, not one mean: the smoke run leaves shard
+    # workers, io threads and subprocess services breathing around this
+    # probe, and a single descheduling spike can double a mean-of-30.
+    # The min is the standard contention-free estimate (timeit's
+    # repeat/min) — and the true cost is what the gate should bill,
+    # because the wall-clock denominator it divides into inflates under
+    # the same contention.
+    best = None
+    for _chunk in range(6):
+        t0 = time.perf_counter_ns()
+        for _ in range(8):
+            h.record_many(vals)
+        dt = (time.perf_counter_ns() - t0) / (8 * width)
+        best = dt if best is None else min(best, dt)
+    return best
+
+
 def _hist_records() -> tuple:
     """(scalar_records, slo_records): record() calls absorbed by every
     histogram in the default registry (counter/gauge writes are
@@ -184,6 +226,167 @@ def _hist_records() -> tuple:
     return scalar, slo
 
 
+def _merged_trace_probe(logdir: str) -> tuple:
+    """2-process causal-trace probe: spawn two standalone host
+    processes (native router + 2 shard workers each, flight recorder
+    live via the ``flight`` config key), drive traced v3 batch frames
+    at both, then pull ONE clock-aligned Perfetto timeline through
+    ``federation_routes``'s /trace?merged=1. Returns
+    ``(summary, failures)`` where failures use the smoke-gate shape.
+
+    Gates: the merged export must carry spans from BOTH processes
+    (process_name metadata + at least one complete span per pid), the
+    router->shard handoff (``ring``/``combine`` span) must start no
+    later than the pipeline span that consumed it on every traced lane,
+    and every aligned timestamp must land inside the probe's own wall
+    window — a blown offset estimate throws a node's spans seconds off
+    the timeline, which is exactly what this catches."""
+    import os
+    import re
+    import socket
+    import subprocess
+    import time
+
+    import numpy as np
+
+    from janus_tpu.net.client import JanusClient
+
+    failures = []
+    os.makedirs(logdir, exist_ok=True)
+    root = pathlib.Path(__file__).resolve().parent.parent
+
+    def _free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    procs, ports, obs_ports = [], [], []
+    try:
+        for i in range(2):
+            op = _free_port()
+            obs_ports.append(op)
+            cfg_path = os.path.join(logdir, f"host{i}.json")
+            with open(cfg_path, "w") as f:
+                json.dump({"num_nodes": 4, "window": 8,
+                           "ops_per_block": 64, "shards": 2,
+                           "native_demux": True, "flight": True,
+                           "port": 0, "obs_port": op,
+                           "log_level": "warning",
+                           "types": [{"type_code": "pnc",
+                                      "dims": {"num_keys": 16}}]}, f)
+            log = open(os.path.join(logdir, f"host{i}.log"), "w")
+            child = subprocess.Popen(
+                [sys.executable, "-m", "janus_tpu.net.service",
+                 cfg_path, "0"],
+                stdout=log, stderr=subprocess.STDOUT, cwd=str(root))
+            procs.append((child, log))
+        deadline = time.time() + 120
+        for child, log in procs:
+            port = None
+            while time.time() < deadline:
+                text = open(log.name).read()
+                m = re.search(r"service on 127\.0\.0\.1:(\d+)", text)
+                if m:
+                    port = int(m.group(1))
+                    break
+                if child.poll() is not None:
+                    raise RuntimeError(f"probe host died:\n{text}")
+                time.sleep(0.1)
+            if port is None:
+                raise TimeoutError("probe host banner never appeared")
+            ports.append(port)
+        t_w0 = time.time_ns()
+        keys = ["k0", "k1", "k2", "k3"]
+        for port in ports:
+            with JanusClient("127.0.0.1", port) as c:
+                for k in keys:
+                    r = c.wait(c.send("pnc", k, "s"), timeout=60)
+                    assert r["result"] == "success", r
+                idx = np.arange(256, dtype=np.int32) % 4
+                for _ in range(4):
+                    seqs = c.send_batch("pnc", keys, idx, "i",
+                                        p0=np.ones(256, np.int64))
+                    c.wait(seqs[-1], timeout=60)
+        t_w1 = time.time_ns()
+        # in-process federation front: same routes a standalone
+        # `python -m janus_tpu.obs.httpexp` scoreboard serves
+        from janus_tpu.obs.httpexp import federation_routes
+
+        peers = [(f"h{i}", f"http://127.0.0.1:{p}")
+                 for i, p in enumerate(obs_ports)]
+        routes = federation_routes(peers, timeout=15.0)
+        _ct, body = routes["/trace"]({})
+        clock = json.loads(body).get("clock") or {}
+        _ct, body = routes["/trace"]({"merged": "1"})
+        events = json.loads(body).get("traceEvents") or []
+        pid_label = {e["pid"]: e["args"]["name"] for e in events
+                     if e.get("name") == "process_name"}
+        spans_per_pid = {}
+        lanes = {}
+        ts_lo, ts_hi = None, None
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            spans_per_pid[e["pid"]] = spans_per_pid.get(e["pid"], 0) + 1
+            lanes.setdefault((e["pid"], e["tid"]), []).append(
+                (e["name"], e["ts"], e.get("dur", 0.0)))
+            ts_lo = e["ts"] if ts_lo is None else min(ts_lo, e["ts"])
+            hi = e["ts"] + e.get("dur", 0.0)
+            ts_hi = hi if ts_hi is None else max(ts_hi, hi)
+        handoff_lanes = ordered = 0
+        for rows in lanes.values():
+            h = [ts for nm, ts, _d in rows if nm in ("ring", "combine")]
+            p = [ts + d for nm, ts, d in rows
+                 if nm in ("ingest", "seal", "dag_round", "commit",
+                           "apply")]
+            if h and p:
+                handoff_lanes += 1
+                if min(h) <= max(p):
+                    ordered += 1
+        summary = {
+            "nodes": sorted(pid_label.values()),
+            "clock": clock,
+            "spans_per_node": {pid_label.get(pid, str(pid)): n
+                               for pid, n in spans_per_pid.items()},
+            "handoff_lanes": handoff_lanes,
+            "handoff_ordered": ordered,
+            "events": len(events),
+        }
+        if sorted(pid_label.values()) != ["h0", "h1"]:
+            failures.append(("merged_trace(missing process)", 1.0))
+        if len(spans_per_pid) < 2 or min(spans_per_pid.values(),
+                                         default=0) == 0:
+            failures.append(("merged_trace(one-sided spans)", 1.0))
+        if handoff_lanes == 0:
+            failures.append(("merged_trace(no handoff lanes)", 1.0))
+        elif ordered < handoff_lanes:
+            failures.append(("merged_trace(handoff misordered)",
+                             1.0 - ordered / handoff_lanes))
+        # aligned timestamps must sit inside the probe's wall window
+        # (generous slack: offsets here are loopback-tiny, a failure
+        # means the alignment arithmetic itself broke)
+        lo_us, hi_us = (t_w0 - 60_000_000_000) / 1e3, \
+            (t_w1 + 60_000_000_000) / 1e3
+        if ts_lo is None or ts_lo < lo_us or ts_hi > hi_us:
+            failures.append(("merged_trace(timeline off-window)", 1.0))
+        return summary, failures
+    finally:
+        import signal as _signal
+
+        for child, log in procs:
+            if child.poll() is None:
+                child.send_signal(_signal.SIGINT)
+        for child, log in procs:
+            try:
+                child.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                child.wait(timeout=15)
+            log.close()
+
+
 def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
     import time
 
@@ -195,6 +398,7 @@ def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
           f"(slo batch: {slo_cost_ns:.1f} ns)", flush=True)
     failures = []
     slo_payload = None  # the wire_sharded preset's row, for the SLO gate
+    nat_payload = None  # the wire_sharded_native row, for the anatomy gate
     with open(out_path, "a") as f:
         for name in sorted(PRESETS):
             cfg = _smoke_cfg(name, PRESETS[name])
@@ -226,6 +430,7 @@ def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
             if cfg.mode == "wire_sharded":
                 slo_payload = payload
             if cfg.mode == "wire_sharded_native":
+                nat_payload = payload
                 # demux gates: the native ring must reproduce the
                 # Python router's state bit-for-bit over the same
                 # schedule, the native arm's ledger must reconcile
@@ -338,13 +543,92 @@ def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
                  recon > 0.01, recon)):
             if bad:
                 failures.append((gate, frac))
+
+        # latency-anatomy row: the segment histograms recorded by the
+        # native sharded arm above must DECOMPOSE its e2e latency.
+        # Per op class with samples the gate accepts either face of
+        # the decomposition: the segment p50s account for >= 95% of
+        # the e2e p50, OR the exact identity holds — total segment ns
+        # within +-5% of total e2e ns. The ns identity is the strong
+        # check (the stamps share one CLOCK_MONOTONIC per op, so sums
+        # must reconcile); the p50 sum is the human-readable anatomy
+        # but medians do not sum across skewed correlated segments
+        # (sum-of-medians <= median-of-sum under right skew) and the
+        # log2-bucket interpolation adds error on top, so it gets the
+        # OR. The reply ledger must reconcile EXACTLY (every scheduled
+        # op replied once — the trace plane may never invent or lose
+        # replies), and the added segment sampling must stay under the
+        # telemetry budget by the same analytical form as the rows
+        # above. Then the 2-process probe: a merged /trace?merged=1
+        # export must put BOTH processes' spans on one clock-aligned
+        # timeline with the router->shard handoff ordered.
+        import os as _os
+
+        an = (nat_payload or {}).get("anatomy") or {}
+        nsr = (nat_payload or {}).get("slo_report") or {}
+        narm = (nat_payload or {}).get("arm_native") or {}
+        classes = [c for c in ("unsafe", "safe", "stable")
+                   if (an.get(c) or {}).get("e2e_samples", 0) > 0]
+        seg_samples = sum(
+            int(sd.get("samples", 0)) for c in classes
+            for sd in ((an.get(c) or {}).get("segments") or {}).values())
+        nshards = max(int(narm.get("shards", 1)), 1)
+        narm_s = float(narm.get("elapsed_s", 0.0))
+        seg_cost_ns = _seg_record_cost_ns()
+        seg_overhead = (seg_samples * seg_cost_ns
+                        / max(nshards * narm_s * 1e9, 1.0))
+        trace_summary, tr_failures = _merged_trace_probe(
+            _os.path.join(_os.path.dirname(_os.path.abspath(out_path)),
+                          "anatomy_probe"))
+        failures.extend(tr_failures)
+        payload = {
+            "run": "smoke_anatomy",
+            "ts": round(time.time(), 1),
+            "config": (nat_payload or {}).get("config", "?"),
+            "anatomy": an,
+            "smoke": {
+                "classes": classes,
+                "coverage_p50": {
+                    c: float((an.get(c) or {}).get("coverage_p50", 0.0))
+                    for c in classes},
+                "coverage_ns": {
+                    c: float((an.get(c) or {}).get("coverage_ns", 0.0))
+                    for c in classes},
+                "seg_samples": seg_samples,
+                "seg_record_cost_ns": round(seg_cost_ns, 1),
+                "seg_overhead_pct": round(100 * seg_overhead, 4),
+                "replied_vs_total": float(
+                    nsr.get("replied_vs_total", 0.0)),
+                "merged_trace": trace_summary,
+            },
+        }
+        line = json.dumps(payload)
+        print(line, flush=True)
+        f.write(line + "\n")
+        f.flush()
+        for gate, bad, frac in (
+                ("anatomy(no classes with samples)", not classes, 1.0),
+                ("anatomy(segment overhead)",
+                 seg_overhead >= overhead_budget, seg_overhead),
+                ("anatomy(counter reconciliation not exact)",
+                 float(nsr.get("replied_vs_total", 0.0)) != 1.0,
+                 abs(float(nsr.get("replied_vs_total", 0.0)) - 1.0))):
+            if bad:
+                failures.append((gate, frac))
+        for c in classes:
+            cov = float((an.get(c) or {}).get("coverage_p50", 0.0))
+            cov_ns = float((an.get(c) or {}).get("coverage_ns", 0.0))
+            if cov < 0.95 and abs(cov_ns - 1.0) > 0.05:
+                failures.append((f"anatomy({c} coverage)", cov))
     if failures:
         raise AssertionError(
             "smoke gates failed (telemetry fast path / SLO plane): "
             + ", ".join(f"{n}: {100 * o:.2f}%" for n, o in failures))
     print(f"# smoke OK: {len(PRESETS)} presets + flight tracing + SLO "
-          f"plane, overhead < {100 * overhead_budget:.0f}% (flight < 3%);"
-          f" oob scrape cpu_frac {oob.get('cpu_frac', '?')}", flush=True)
+          f"plane + latency anatomy, overhead < "
+          f"{100 * overhead_budget:.0f}% (flight < 3%); oob scrape "
+          f"cpu_frac {oob.get('cpu_frac', '?')}; anatomy coverage "
+          f"{payload['smoke']['coverage_p50']}", flush=True)
 
 
 def main() -> None:

@@ -29,8 +29,8 @@ def test_smoke_matrix_all_presets(tmp_path):
     mod.run_smoke(str(out))
 
     rows = [json.loads(line) for line in out.read_text().splitlines()]
-    # + the flight-overhead row + the SLO-plane row
-    assert len(rows) == len(PRESETS) + 2
+    # + the flight-overhead row + the SLO-plane row + the anatomy row
+    assert len(rows) == len(PRESETS) + 3
     by_run = {r["run"]: r for r in rows}
     for name in PRESETS:
         row = by_run[f"smoke_{name}"]
@@ -89,3 +89,31 @@ def test_smoke_matrix_all_presets(tmp_path):
     assert oob["scrapes"] > 0 and oob["scrape_errors"] == 0
     assert oob["health_ms"] < 250.0 and oob["slo_ms"] < 250.0
     assert oob["cpu_frac"] < 0.02
+    # latency anatomy (run_smoke gates these; re-assert the row shape):
+    # the native sharded arm's segment histograms decomposed its e2e
+    # latency per op class — the gate accepts >= 95% p50 coverage OR
+    # the exact ns-sum identity within +-5% (medians don't sum across
+    # skewed correlated segments) — the reply ledger reconciled
+    # EXACTLY, and the 2-process probe merged both hosts' spans onto
+    # one clock-aligned timeline with every router->shard handoff
+    # lane ordered
+    an = by_run["smoke_anatomy"]
+    assert an["smoke"]["classes"], "no op class recorded segments"
+    for cls, cov in an["smoke"]["coverage_p50"].items():
+        cov_ns = an["smoke"]["coverage_ns"][cls]
+        # no floor on cov alone: under a degraded bimodal run (ring
+        # p50 0.2s / mean 1.3s observed under full-suite pressure)
+        # sum-of-medians legitimately collapses while the ns identity
+        # still reconciles to ~1.000 — that identity is the invariant
+        assert cov >= 0.95 or abs(cov_ns - 1.0) <= 0.05, \
+            (cls, cov, cov_ns)
+    assert an["smoke"]["replied_vs_total"] == 1.0
+    assert an["smoke"]["seg_overhead_pct"] < 2.0
+    mt = an["smoke"]["merged_trace"]
+    assert mt["nodes"] == ["h0", "h1"]
+    assert all(n > 0 for n in mt["spans_per_node"].values())
+    assert mt["handoff_lanes"] > 0
+    assert mt["handoff_ordered"] == mt["handoff_lanes"]
+    assert set(mt["clock"]) == {"h0", "h1"}
+    for peer in mt["clock"].values():
+        assert peer["rtt_ns"] > 0
