@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import random
 import socket
 import struct
 import threading
@@ -23,6 +24,26 @@ import time
 from typing import Dict, Iterable, Optional, Sequence
 
 import numpy as np
+
+# payload prefix of an admission-control nack ("shed: retry_after_ms=N").
+# The shed status rides the ordinary err reply (ok=False + this payload
+# text), so pre-overload clients degrade to a plain nack for free while
+# upgraded clients parse the retry hint out of the text.
+SHED_PREFIX = "shed: retry_after_ms="
+_SHED_PAT = SHED_PREFIX.encode()
+
+
+def parse_retry_after(payload: str) -> Optional[int]:
+    """Retry hint (ms) from a shed-nack payload, or None if the payload
+    is not a shed nack. Tolerates trailing text after the integer."""
+    if not payload.startswith(SHED_PREFIX):
+        return None
+    digits = ""
+    for ch in payload[len(SHED_PREFIX):]:
+        if not ch.isdigit():
+            break
+        digits += ch
+    return int(digits) if digits else None
 
 # per-process sender nonce: combined with the pid and the frame's seq0 it
 # makes every frame's wire trace id unique across a split cluster's
@@ -230,12 +251,18 @@ class JanusClient:
                         # wait) still clears its _safe_seqs entry
                         safe = seq in self._safe_seqs
                         self._safe_seqs.discard(seq)
-                        status = ("err" if not parsed["ok"]
+                        ra = (parse_retry_after(str(parsed["payload"]))
+                              if not parsed["ok"] else None)
+                        status = ("shed" if ra is not None
+                                  else "err" if not parsed["ok"]
                                   else ("su" if safe else "ok"))
-                        self._replies[seq] = {
+                        rep = {
                             "seq": seq, "result": parsed["payload"],
                             "response": status,
                         }
+                        if ra is not None:
+                            rep["retry_after_ms"] = ra
+                        self._replies[seq] = rep
                         self._cv.notify_all()
 
     @staticmethod
@@ -345,6 +372,33 @@ class JanusClient:
         return self.wait(self.send(type_code, key, op_code, params, is_safe),
                          timeout)
 
+    def request_with_retry(self, type_code: str, key: str, op_code: str,
+                           params: Iterable[str] = (),
+                           is_safe: bool = False,
+                           timeout: Optional[float] = None,
+                           retries: int = 8,
+                           backoff_cap_ms: int = 1000) -> Dict[str, object]:
+        """``request`` that honors admission-control shed nacks: on a
+        "shed" reply it sleeps the server's retry hint (which also
+        floors the backoff), doubling with each consecutive shed up to
+        ``backoff_cap_ms``, with +/-50% jitter so a thundering herd of
+        shed clients does not re-arrive in lockstep. Gives up after
+        ``retries`` retries and returns the final shed reply — the
+        caller sees the same dict shape either way."""
+        rng = random.Random(self._sender_id * 0x9E3779B1 + 1)
+        delay_ms = 0.0
+        rep: Dict[str, object] = {}
+        for _ in range(max(1, retries + 1)):
+            rep = self.request(type_code, key, op_code, params, is_safe,
+                               timeout)
+            if rep.get("response") != "shed":
+                return rep
+            hint = float(rep.get("retry_after_ms", 25) or 25)
+            delay_ms = min(float(backoff_cap_ms),
+                           max(hint, delay_ms * 2.0))
+            time.sleep(delay_ms * (0.5 + rng.random()) * 1e-3)
+        return rep
+
     # -- telemetry scrape helpers ---------------------------------------
 
     def metrics_text(self, timeout: Optional[float] = None) -> str:
@@ -406,15 +460,38 @@ class BatchSender:
 
     The drain thread is NOT optional: the service's native reply send
     blocks on a full client TCP buffer, so an un-drained sender would
-    wedge the whole reply flush."""
+    wedge the whole reply flush.
 
-    def __init__(self, host: str, port: int, timeout: float = 60.0):
+    The drain does watch for one thing: admission-control shed nacks.
+    It substring-scans each chunk for the shed payload (a C-level
+    ``bytes.count`` — full per-reply decode would throttle the offered
+    load back into a closed loop), counts them into ``shed_replies``,
+    and keeps the server's latest retry hint. ``send_frame`` then backs
+    off before offering more load whenever new sheds arrived since the
+    last frame: bounded exponential (hint-floored, doubling per
+    consecutive shed window, capped) with +/-50% jitter. Pass
+    ``backoff=False`` for a sender that deliberately ignores the server
+    — overload sweeps use that to hold offered load constant."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0,
+                 backoff: bool = True, backoff_cap_ms: int = 1000):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sender_id = next(_SENDER_IDS)
         self._seq = 0
         self._closed = False
         self.reply_bytes = 0
+        # shed-nack sightings from the drain thread (racy reads are
+        # fine: the backoff only needs "more than last time")
+        self.shed_replies = 0
+        self.retry_after_ms = 0  # latest server hint; 0 = none yet
+        self.backoff = backoff
+        self.backoff_cap_ms = int(backoff_cap_ms)
+        self.backoff_sleeps = 0  # frames that paid a backoff sleep
+        self._shed_seen = 0
+        self._streak = 0
+        self._rng = random.Random(self._sender_id * 0x9E3779B1)
+        self._tail = b""
         self._rx = threading.Thread(target=self._drain, daemon=True)
         self._rx.start()
 
@@ -427,10 +504,43 @@ class BatchSender:
             if not chunk:
                 break
             self.reply_bytes += len(chunk)
+            # shed scan with a pattern-length carry so a nack split
+            # across two recv chunks still counts — the carry is one
+            # byte short of the pattern, so it can never hold a whole
+            # pattern and recount it next chunk
+            data = self._tail + chunk
+            self._tail = data[-(len(_SHED_PAT) - 1):]
+            n = data.count(_SHED_PAT)
+            if n:
+                self.shed_replies += n
+                j = data.rfind(_SHED_PAT) + len(_SHED_PAT)
+                k = j
+                while k < len(data) and 0x30 <= data[k] <= 0x39:
+                    k += 1
+                if k > j:
+                    self.retry_after_ms = int(data[j:k])
+
+    def _maybe_backoff(self) -> None:
+        """Pre-send gate: sleep out the shed backoff when the drain saw
+        new nacks since the last frame; a shed-free frame resets the
+        exponential streak."""
+        shed = self.shed_replies
+        if shed <= self._shed_seen:
+            self._streak = 0
+            return
+        self._shed_seen = shed
+        self._streak += 1
+        base = float(max(self.retry_after_ms, 1))
+        delay = min(float(self.backoff_cap_ms),
+                    base * (1 << min(self._streak - 1, 6)))
+        self.backoff_sleeps += 1
+        time.sleep(delay * (0.5 + self._rng.random()) * 1e-3)
 
     def send_frame(self, type_code: str, keys: Sequence[str], key_idx,
                    op_codes, p0=None, is_safe=None) -> int:
         """Send one columnar batch frame; returns the op count."""
+        if self.backoff:
+            self._maybe_backoff()
         key_idx = np.asarray(key_idx, np.int32)
         m = len(key_idx)
         if isinstance(op_codes, str):

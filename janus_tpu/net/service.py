@@ -118,10 +118,35 @@ class JanusConfig:
     # Python router fallback: the front-end polls the wire, demuxes
     # with numpy, and copies columns into each worker's _ShardInbox.
     native_demux: bool = True
-    # _ShardInbox / native-ring soft bound: observations of a depth past
-    # this bump shard{K}_inbox_overflow_total — the sensor admission
-    # control needs (nothing is shed yet; the slo shed counter stays 0)
+    # _ShardInbox / native-ring soft bound: ops arriving past this depth
+    # bump shard{K}_inbox_overflow_ops_total (plus one edge-triggered
+    # ..._episodes_total per crossing) — the overload sensor. Crossing
+    # the SOFT cap never sheds; it is the early-warning tripwire.
     inbox_soft_cap: int = 1 << 20
+    # admission-control HARD cap per shard (ops queued at the door). 0
+    # disables shedding entirely (legacy behavior). Past this depth,
+    # unsafe-class ops are SHED with a retry-after nack and counted in
+    # the slo shed counters; safe/stable ops are never shed, only
+    # deferred — their consensus contract survives any flood.
+    inbox_hard_cap: int = 0
+    # retry hint (ms) carried in the shed nack's payload text
+    # ("shed: retry_after_ms=N"); scaled up with queue depth so a 20x
+    # flood backs off harder than a marginal overflow
+    retry_after_ms: int = 25
+    # priority lanes: fraction of each consensus block's B lanes
+    # reserved for safe/stable-carrying entries while such entries are
+    # waiting — a pure-unsafe flood cannot crowd consensus-bound ops
+    # out of a block. Reserved lanes backfill with unsafe work whenever
+    # no safe work wants them, so pure-unsafe throughput is unchanged.
+    # 0.0 disables the reservation.
+    safe_lane_frac: float = 0.25
+    # SLO-driven overload controller (obs/scheduler.py slo mode): each
+    # shard worker closes the loop from its live SloLedger, co-
+    # scheduling block size, the drain hold-off (ingest_wait_ms), and
+    # the unsafe shed probability at the AIMD cadence. Off by default.
+    slo_controller: bool = False
+    # unsafe e2e p99 the controller defends (ms)
+    slo_p99_target_ms: float = 250.0
     # op accumulation: defer the device round while ONLY ingest-acked
     # update work is pending (no reads, no safe acks or creates in
     # flight) until this many client ops accumulate or ingest_wait_ms
@@ -192,6 +217,11 @@ class JanusConfig:
             shard_devices=bool(raw.get("shard_devices", False)),
             native_demux=bool(raw.get("native_demux", True)),
             inbox_soft_cap=int(raw.get("inbox_soft_cap", 1 << 20)),
+            inbox_hard_cap=int(raw.get("inbox_hard_cap", 0)),
+            retry_after_ms=int(raw.get("retry_after_ms", 25)),
+            safe_lane_frac=float(raw.get("safe_lane_frac", 0.25)),
+            slo_controller=bool(raw.get("slo_controller", False)),
+            slo_p99_target_ms=float(raw.get("slo_p99_target_ms", 250.0)),
             ingest_batch=int(raw.get("ingest_batch", 0)),
             ingest_wait_ms=float(raw.get("ingest_wait_ms", 10.0)),
             watchdog_stall_ticks=int(raw.get("watchdog_stall_ticks", 200)),
@@ -499,11 +529,14 @@ class _ShardInbox:
     One lock, two list swaps; depth is kept incrementally so the
     queue-depth gauge never walks the chunks.
 
-    ``hwm``/``overflows`` are growth sensors: the high-watermark feeds
-    the shard{K}_inbox_hwm gauge, and every put that lands past
-    ``soft_cap`` bumps ``overflows`` (-> shard{K}_inbox_overflow_total).
-    Nothing is shed — the cap is the admission-control tripwire, not a
-    drop policy, so the slo ``shed`` counter stays structurally zero."""
+    ``hwm``/``overflow_*`` are growth sensors: the high-watermark feeds
+    the shard{K}_inbox_hwm gauge; ``overflow_ops`` counts the OPS that
+    arrived while depth sat past ``soft_cap`` (pressure magnitude) and
+    ``overflow_episodes`` bumps once per crossing from below (burst
+    count). The soft cap itself never sheds — shedding is the HARD
+    cap's policy, applied by the router/worker before ops reach here
+    and accounted in the slo ``shed`` counters, so every op that makes
+    it into this inbox is already admitted."""
 
     def __init__(self, soft_cap: int = 1 << 20):
         self._lock = threading.Lock()
@@ -511,21 +544,28 @@ class _ShardInbox:
         self.depth = 0  # ops currently queued (racy read is fine)
         self.soft_cap = soft_cap
         self.hwm = 0  # deepest the inbox has ever been
-        self.overflows = 0  # puts that pushed depth past soft_cap
+        self.overflow_ops = 0       # ops put while depth past soft_cap
+        self.overflow_episodes = 0  # depth crossings of soft_cap
+        self._over = False          # currently past soft_cap?
 
     def put(self, cols: Dict[str, np.ndarray]) -> None:
         with self._lock:
             self._chunks.append(cols)
-            self.depth += len(cols["client_tag"])
+            n = len(cols["client_tag"])
+            self.depth += n
             if self.depth > self.hwm:
                 self.hwm = self.depth
             if self.depth > self.soft_cap:
-                self.overflows += 1
+                self.overflow_ops += n
+                if not self._over:
+                    self._over = True
+                    self.overflow_episodes += 1
 
     def drain(self) -> Dict[str, np.ndarray]:
         with self._lock:
             chunks, self._chunks = self._chunks, []
             self.depth = 0
+            self._over = False  # re-arm the episode edge
         if not chunks:
             return {f: np.empty(0, dt) for f, dt in _POLL_FIELDS}
         if len(chunks) == 1:
@@ -717,9 +757,44 @@ class JanusService:
         # bumped at route time, so drain accounting must not re-count)
         self._native_ring = (self._shard_id is not None
                              and cfg.shards > 1 and cfg.native_demux)
-        self._overflow_seen = 0  # overflow events already exported
-        self._ring_overflows = 0  # native-ring depth-past-cap sightings
+        self._ovf_ops_seen = 0  # inbox overflow ops already exported
+        self._ovf_eps_seen = 0  # inbox overflow episodes already exported
+        self._ring_overflows = 0  # native-ring ops seen past the soft cap
+        self._ring_over = False  # native ring currently past soft cap?
+        self._ring_episodes = 0  # native-ring soft-cap crossings
         self._ring_hold_t0 = None  # drain hold-off window start
+        # -- overload-control plane (shard workers only) -----------------
+        # runtime drain hold-off: starts at the configured value; the
+        # SLO controller moves it live (cfg stays frozen)
+        self._ingest_wait_ms = float(cfg.ingest_wait_ms)
+        # live unsafe shed probability (0.0 = admission-only shedding at
+        # the hard cap); actuated by the SLO controller. The sample is
+        # deterministic (floor(n_unsafe * prob), tail-first) so sweeps
+        # reproduce exactly
+        self._shed_prob = 0.0
+        # bulk shed nacks: arrays of client tags sharing one retry-after
+        # payload, flushed via reply_bulk (one native call per payload)
+        self._nack_bulk: List[Tuple[np.ndarray, str]] = []
+        self._ovl: Optional[AdaptiveTick] = None
+        # controller evidence deltas: last-seen cumulative replied total
+        # and unsafe e2e bucket counts, for per-window goodput/p99
+        self._ovl_last_admitted = 0
+        self._ovl_last_t = time.perf_counter()
+        self._ovl_last_buckets: Optional[List[int]] = None
+        self._ovl_adjusts = 0  # controller decisions taken
+        self._ovl_ns = 0  # cumulative controller wall ns (overhead probe)
+        if (cfg.slo_controller and self._shard_id is not None
+                and self.slo is not None):
+            self._ovl = AdaptiveTick(
+                SchedulerConfig(
+                    b_min=cfg.block_floor, b_max=cfg.ops_per_block,
+                    window=cfg.window,
+                    latency_target_ms=cfg.block_target_ms,
+                    slo_p99_target_ms=cfg.slo_p99_target_ms,
+                    wait0_ms=cfg.ingest_wait_ms,
+                    wait_max_ms=max(50.0, cfg.ingest_wait_ms * 5.0)),
+                b0=cfg.ops_per_block,
+                scope=f"ovl_s{self._shard_id}")
         if self._inbox is not None:
             self._shard_m = obs_metrics.shard_instruments(self._shard_id)
             if cfg.shard_devices:
@@ -911,6 +986,13 @@ class JanusService:
             bulks, self._ack_bulk = self._ack_bulk, []
             for arr in bulks:
                 self.server.reply_bulk(arr)
+        if self._nack_bulk:
+            # shed nacks ride the same one-native-call bulk path: every
+            # tag in an array shares one retry-after payload, so a
+            # 10^5-op shed costs one frame render, not 10^5
+            nacks, self._nack_bulk = self._nack_bulk, []
+            for arr, text in nacks:
+                self.server.reply_bulk(arr, ok=False, text=text)
         if self._reply_buf:
             buf, self._reply_buf = self._reply_buf, []
             self.server.reply_batch(buf)
@@ -967,8 +1049,11 @@ class JanusService:
                 self._shard_m["inbox_hwm"].max(max(
                     self.server.shard_hwm(self._shard_id),
                     self._inbox.hwm))
-                if ring_depth > self.cfg.inbox_soft_cap:
-                    self._ring_overflows += 1
+                ring_over = ring_depth > self.cfg.inbox_soft_cap
+                if ring_over and not self._ring_over:
+                    self._ring_episodes += 1
+                self._ring_over = ring_over
+                door_depth = ring_depth + self._inbox.depth
                 cap = min(65536, max(_POLL_FLOOR,
                                      n * self.cfg.ops_per_block))
                 # drain hold-off — the poll-level twin of the op
@@ -991,7 +1076,7 @@ class JanusService:
                     if self._ring_hold_t0 is None:
                         self._ring_hold_t0 = now_pc
                     if (now_pc - self._ring_hold_t0
-                            < self.cfg.ingest_wait_ms * 1e-3):
+                            < self._ingest_wait_ms * 1e-3):
                         self._last_step_end = time.perf_counter()
                         return False  # pump naps; the core goes to io
                 self._ring_hold_t0 = None
@@ -1000,6 +1085,10 @@ class JanusService:
                 # the ring drain IS the offer for these ops (the front
                 # never saw them); inbox strays were offered at route
                 offer_n = len(polled["client_tag"])
+                if ring_over:
+                    # ops drained while the ring sat past the soft cap:
+                    # the ops-flavored half of the overflow sensor
+                    self._ring_overflows += offer_n
                 # drain combined counter blocks AFTER the per-op ring:
                 # any block the io thread pushed before a ring op we
                 # just drained is necessarily caught here, so the
@@ -1015,14 +1104,23 @@ class JanusService:
                         polled = {f: np.concatenate([polled[f], extra[f]])
                                   for f, _ in _POLL_FIELDS}
             else:
-                self._shard_m["queue_depth"].set(self._inbox.depth)
+                door_depth = self._inbox.depth
+                self._shard_m["queue_depth"].set(door_depth)
                 self._shard_m["inbox_hwm"].max(self._inbox.hwm)
                 polled = self._inbox.drain()
-            ovf = self._inbox.overflows + self._ring_overflows
-            if ovf > self._overflow_seen:
-                self._shard_m["inbox_overflow"].add(
-                    ovf - self._overflow_seen)
-                self._overflow_seen = ovf
+            ovf_ops = self._inbox.overflow_ops + self._ring_overflows
+            ovf_eps = self._inbox.overflow_episodes + self._ring_episodes
+            if ovf_ops > self._ovf_ops_seen:
+                self._shard_m["inbox_overflow_ops"].add(
+                    ovf_ops - self._ovf_ops_seen)
+                self._ovf_ops_seen = ovf_ops
+            if ovf_eps > self._ovf_eps_seen:
+                self._shard_m["inbox_overflow_episodes"].add(
+                    ovf_eps - self._ovf_eps_seen)
+                self._ovf_eps_seen = ovf_eps
+            # admission control: shed-or-defer at the drain (the door's
+            # hard-cap policy plus the controller's shed probability)
+            polled, _shed_n = self._shed_unsafe(polled, door_depth)
         else:
             polled = self.server.poll_batch(
                 min(65536, max(_POLL_FLOOR,
@@ -1032,15 +1130,18 @@ class JanusService:
         count = len(polled["client_tag"])
         slow_idx = None
         reads: List[dict] = []
+        # SLO plane: offered is owed at drain for ops whose drain is
+        # their first sighting (unsharded poll, native ring) — the
+        # router bumps offered at route time for inbox traffic. Counted
+        # PRE-shed and outside the count gate: a poll shed in its
+        # entirety still happened, and its ops are offered + shed
+        if offer_n:
+            self.slo.offered.add(offer_n)
         if count:
             self.perf.add(count)
-            # SLO plane: admitted = ops this step loop drained; offered
-            # is owed here for ops whose drain is their first sighting
-            # (unsharded poll, native ring) — the router bumps offered
-            # at route time for inbox traffic
+            # admitted = ops this step loop accepted for execution
+            # (post-shed — offered == admitted + shed holds exactly)
             self.slo.admitted.add(count)
-            if offer_n:
-                self.slo.offered.add(offer_n)
             if self._shard_m is not None:
                 self._shard_m["ops_total"].add(count)
             self._record_wire_ring(polled)
@@ -1146,7 +1247,7 @@ class JanusService:
         if (self.cfg.ingest_batch > 0 and not reads
                 and not self._deferred_reads and not self._waiting
                 and time.perf_counter() - self._last_round_t
-                    < self.cfg.ingest_wait_ms * 1e-3
+                    < self._ingest_wait_ms * 1e-3
                 and all(not rt.ack_map and not rt.create_tags
                         for rt in self.types.values())
                 and sum(_pending_total(rt.pending)
@@ -1172,6 +1273,14 @@ class JanusService:
                 sum(_entry_ops(e) for q in rt.pending for e in q))
         self.ticks += 1
         self._last_round_t = time.perf_counter()
+        # overload-plane evidence: the shed-storm detector watches the
+        # cumulative SLO counters once per tick; the controller (when
+        # enabled) reads the same ledger and actuates shed/wait/block
+        self.watchdog.observe_shed(
+            f"s{self._shard_id}" if self._shard_id is not None else "svc",
+            int(self.slo.shed.value), int(self.slo.offered.value))
+        if self._ovl is not None:
+            self._ovl_step(t_step)
 
         # answer reads post-tick, once (a) the key's create has committed
         # in the home view and (b) every earlier update from the same
@@ -1208,6 +1317,115 @@ class JanusService:
         if self._shard_m is not None:
             self._last_step_end = time.perf_counter()
         return busy
+
+    def _shed_unsafe(self, polled: Dict[str, np.ndarray],
+                     door_depth: int) -> Tuple[Dict[str, np.ndarray], int]:
+        """Admission control at the drain: past the hard cap the
+        newest unsafe-class ops beyond it are shed with a retry-after
+        nack; below it the controller's live shed probability thins
+        the unsafe TAIL. Safe and stable ops — and creates — are NEVER
+        shed, at any depth: they are consensus-bound, and the contract
+        their class sells is exactly that overload defers them rather
+        than refuses them. Combined counter blocks are likewise exempt
+        (they are already collapsed to at most K lanes per block, so
+        executing them is nearly free — shedding them would refuse work
+        that costs nothing). Returns the filtered poll columns and the
+        shed count; all accounting (shed counters, nack replies) lands
+        here so offered == admitted + shed holds at every call site."""
+        hard = self.cfg.inbox_hard_cap
+        prob = self._shed_prob
+        n = len(polled["client_tag"])
+        if n == 0 or (hard <= 0 and prob <= 0.0):
+            return polled, 0
+        over_hard = hard > 0 and door_depth > hard
+        if not over_hard and prob <= 0.0:
+            return polled, 0
+        opc = polled["op_code"]
+        stable_m = np.isin(opc, self._stable_opcs)
+        safe_m = ~stable_m & (polled["is_safe"].astype(bool)
+                              | (opc == np.int32(ord("s"))))
+        unsafe_m = ~stable_m & ~safe_m
+        n_unsafe = int(unsafe_m.sum())
+        if n_unsafe == 0:
+            return polled, 0
+        # past the hard cap, shed only the EXCESS over it — the door
+        # (or, on the native path, this drain itself) already admitted
+        # the rest, and refusing admitted work collapses goodput for
+        # no protection. The controller's probability thins on top.
+        k = min(n_unsafe, door_depth - hard) if over_hard else 0
+        k = max(k, int(n_unsafe * prob))
+        if k <= 0:
+            return polled, 0
+        # shed the newest arrivals: the admitted prefix keeps its
+        # FIFO order and the clients asked to retry are the ones
+        # whose ops have waited least
+        idx = np.flatnonzero(unsafe_m)[-k:]
+        shed_m = np.zeros(n, bool)
+        shed_m[idx] = True
+        n_shed = int(shed_m.sum())
+        tags = polled["client_tag"][shed_m].astype(np.uint64)
+        # retry hint scales with how far past the cap the door sits, so
+        # a 20x flood is told to back off harder than a marginal burst
+        ra = int(self.cfg.retry_after_ms)
+        if hard > 0 and door_depth > hard:
+            ra = min(1000, ra * max(1, -(-door_depth // hard)))
+        self._nack_bulk.append((tags, f"shed: retry_after_ms={ra}"))
+        # ledger: shed ops stay offered, never admitted; the nack IS
+        # their reply (refused, not served — no latency sample), which
+        # keeps replied_total reconcilable with offered after drain
+        self.slo.shed_op("unsafe", n_shed)
+        self.slo.replied["unsafe"].add(n_shed)
+        keep = ~shed_m
+        return {f: v[keep] for f, v in polled.items()}, n_shed
+
+    def _ovl_step(self, t_step: float) -> None:
+        """One tick of the SLO-driven overload controller: read the
+        live ledger (goodput, unsafe p99 over the window, door depth vs
+        the hard cap), feed the AIMD scheduler, and actuate whatever it
+        decided — block size, drain hold-off, unsafe shed probability.
+        The whole method is timed into ``_ovl_ns`` so the bench matrix
+        can assert the control loop's overhead stays negligible."""
+        t_ctl = time.perf_counter_ns()
+        ovl = self._ovl
+        now = time.perf_counter()
+        step_ms = 1e3 * (now - t_step)
+        depth = self._inbox.depth if self._inbox is not None else 0
+        if self._native_ring:
+            depth += self.server.shard_depth(self._shard_id)
+        backlog = sum(_pending_total(rt.pending)
+                      for rt in self.types.values())
+        ovl.observe(max(depth, backlog), step_ms)
+        # goodput is ADMITTED work, not replies: a shed nack also
+        # counts as a reply, so a replied-based signal stays flat
+        # while real goodput collapses — the guard would never fire
+        admitted = int(self.slo.admitted.value)
+        dt = now - self._ovl_last_t
+        goodput = ((admitted - self._ovl_last_admitted) / dt
+                   if dt > 0 else 0.0)
+        # window p99 from the unsafe e2e bucket-count DELTAS — the
+        # cumulative histogram would average the whole run into the
+        # verdict and never see a regression
+        cts = self.slo.e2e["unsafe"].counts()
+        last = self._ovl_last_buckets
+        delta = (cts if last is None
+                 else [a - b for a, b in zip(cts, last)])
+        p99_ms = obs_metrics.percentile_from_counts(delta, 0.99) / 1e6
+        hard = self.cfg.inbox_hard_cap
+        depth_frac = depth / hard if hard > 0 else 0.0
+        ovl.observe_slo(goodput, p99_ms, depth_frac)
+        self._ovl_last_admitted = admitted
+        self._ovl_last_t = now
+        self._ovl_last_buckets = cts
+        new_b = ovl.maybe_adjust()
+        if new_b is not None and not self.cfg.adaptive_block:
+            # resize may refuse while tail lanes hold live ops; the
+            # target is simply retried at the next adjust
+            for rt in self.types.values():
+                rt.kv.resize_block(new_b)
+            self._ovl_adjusts += 1
+        self._shed_prob = ovl.shed_prob
+        self._ingest_wait_ms = ovl.wait_ms
+        self._ovl_ns += time.perf_counter_ns() - t_ctl
 
     def _ingest(self, it: dict, reads: List[dict], pos: int = 0) -> None:
         """Route one wire op: reply, stage for a block (at arrival
@@ -1924,40 +2142,53 @@ class JanusService:
         # columnar chunks boarded this step: per home, (b0, cols)
         fast_placed: List[List[Tuple[int, Dict[str, np.ndarray]]]] = [
             [] for _ in range(n)]
+        # priority lanes: reserve a slice of each block for entries
+        # carrying safe/stable work (safe updates, creates) so a
+        # pure-unsafe flood cannot crowd consensus-bound ops out of the
+        # block. Pure-unsafe entries past the unsafe lane budget are
+        # SKIPPED (set aside, scan continues hunting safe work), then
+        # backfilled into any lanes no safe entry claimed — reservation
+        # costs pure-unsafe workloads nothing. Deferred entries return
+        # to the queue FRONT, so they board first next step; the
+        # resulting reorder is sound: CRDT updates commute, and
+        # read-your-writes is gated on _conn_pending counts, not on
+        # queue position.
+        reserve = (min(B - 1, int(B * cfg.safe_lane_frac))
+                   if cfg.safe_lane_frac > 0.0 else 0)
+        _SCAN_CAP = 512  # entries set aside before the hunt gives up
         for v in range(n):
             b = 0
-            # one FIFO in arrival order: per-item entries board singly,
-            # columnar chunks by slice (a partially boarded chunk keeps
-            # its tail at the queue head)
-            while rt.pending[v] and b < B:
-                entry = rt.pending[v].popleft()
-                if entry[0] == "chunk":
-                    cols = entry[1]
-                    cnt = len(cols["tag"])
-                    take = min(B - b, cnt)
-                    if take < cnt and "pend" in cols:
-                        # combined chunks board atomically — their
-                        # aggregate conn accounting cannot split. Lane
-                        # count is bounded by distinct (op, key) pairs,
-                        # far under any block size, so this only defers
-                        # when the block is nearly full already.
-                        rt.pending[v].appendleft(entry)
-                        break
-                    if take < cnt:
-                        head = {f: a[:take] for f, a in cols.items()}
-                        rt.pending[v].appendleft(
-                            ("chunk", {f: a[take:]
-                                       for f, a in cols.items()}))
-                    else:
-                        head = cols
-                    for name in ("op", "key", "a0", "a1", "a2"):
-                        batch[name][v, b: b + take] = head[name]
-                    batch["writer"][v, b: b + take] = v
-                    safe[v, b: b + take] = head["safe"]
-                    fast_placed[v].append((b, head))
-                    taken[v].append(("chunk", head))
-                    b += take
-                    continue
+            b_unsafe = 0  # lanes holding pure-unsafe content
+
+            def _board_chunk(cols, limit):
+                """Board up to ``limit`` lanes of a columnar chunk at
+                lane ``b``; returns the unboarded tail (or None)."""
+                nonlocal b
+                cnt = len(cols["tag"])
+                take = min(limit, cnt)
+                if take <= 0:
+                    return cols
+                if take < cnt and "pend" in cols:
+                    # combined chunks board atomically — their
+                    # aggregate conn accounting cannot split. Lane
+                    # count is bounded by distinct (op, key) pairs,
+                    # far under any block size, so this only defers
+                    # when the budget is nearly spent already.
+                    return cols
+                head = (cols if take == cnt
+                        else {f: a[:take] for f, a in cols.items()})
+                for name in ("op", "key", "a0", "a1", "a2"):
+                    batch[name][v, b: b + take] = head[name]
+                batch["writer"][v, b: b + take] = v
+                safe[v, b: b + take] = head["safe"]
+                fast_placed[v].append((b, head))
+                taken[v].append(("chunk", head))
+                b += take
+                return (None if take == cnt
+                        else {f: a[take:] for f, a in cols.items()})
+
+            def _board_item(entry):
+                nonlocal b
                 _kind, fields, tag, is_safe, create_key, t0, trc = entry
                 taken[v].append(entry)
                 if fields is not None:
@@ -1969,6 +2200,56 @@ class JanusService:
                 safe[v, b] = is_safe
                 placed[v].append((b, is_safe, tag, create_key, t0, trc))
                 b += 1
+
+            # one FIFO in arrival order: per-item entries board singly,
+            # columnar chunks by slice (a partially boarded chunk keeps
+            # its tail at the queue head)
+            deferred: List[tuple] = []
+            while rt.pending[v] and b < B and len(deferred) < _SCAN_CAP:
+                entry = rt.pending[v].popleft()
+                if entry[0] == "chunk":
+                    cols = entry[1]
+                    pure = reserve > 0 and not bool(cols["safe"].any())
+                    lim = (min(B - b, (B - reserve) - b_unsafe)
+                           if pure else B - b)
+                    b0 = b
+                    left = _board_chunk(cols, lim)
+                    if pure:
+                        b_unsafe += b - b0
+                    if left is not None:
+                        if pure and b < B:
+                            # unsafe lane budget spent, block not full:
+                            # set the tail aside and keep hunting for
+                            # safe-carrying entries
+                            deferred.append(("chunk", left))
+                            continue
+                        rt.pending[v].appendleft(("chunk", left))
+                        break
+                    continue
+                is_safe, create_key = entry[3], entry[4]
+                pure = (reserve > 0 and not is_safe
+                        and create_key is None)
+                if pure and b_unsafe >= B - reserve:
+                    deferred.append(entry)
+                    continue
+                _board_item(entry)
+                if pure:
+                    b_unsafe += 1
+            # backfill: reserved lanes with no safe claimant go to the
+            # deferred unsafe work, oldest first
+            di = 0
+            while di < len(deferred) and b < B:
+                entry = deferred[di]
+                if entry[0] == "chunk":
+                    left = _board_chunk(entry[1], B - b)
+                    if left is not None:
+                        deferred[di] = ("chunk", left)
+                        break
+                else:
+                    _board_item(entry)
+                di += 1
+            for entry in reversed(deferred[di:]):
+                rt.pending[v].appendleft(entry)
         # record only payload-bearing blocks in latency stats; idle
         # keep-alive rounds must not grow host logs or dilute metrics
         record = np.asarray([bool(placed[v]) or bool(fast_placed[v])
@@ -2016,6 +2297,11 @@ class JanusService:
         tb0 = time.monotonic_ns()
         if rt.node is not None:
             info = rt.node.step(ops, safe=safe, record=record)
+            # surface the node's key-exchange verdict every step: a
+            # blown retry budget raises DEGRADED, completion clears it
+            self.watchdog.observe_key_exchange(
+                rt.spec.type_code,
+                getattr(rt.node, "degraded_reason", None))
             if info is None:  # key exchange incomplete: requeue all
                 for v in range(n):
                     requeue(v)
@@ -2190,15 +2476,25 @@ class JanusService:
         tid_arr = polled["type_id"]
         ctrl = np.isin(tid_arr, self._ctrl_tids)
         shard = self._route_shards(polled, ~ctrl)
+        hard = cfg.inbox_hard_cap
         for k, w in enumerate(self.workers):
             m = shard == k
             if m.any():
                 # fancy-index COPIES — inbox chunks must not alias the
                 # native poll buffers, which the next poll overwrites
-                w._inbox.put({f: v[m] for f, v in polled.items()})
-                # offered = ops handed to the shard (admitted is bumped
-                # by the worker when its step loop drains them)
-                w.slo.offered.add(int(m.sum()))
+                cols = {f: v[m] for f, v in polled.items()}
+                # offered = ops handed to the shard's door (admitted is
+                # bumped by the worker when its step loop drains them;
+                # anything the door sheds below stays offered)
+                w.slo.offered.add(len(cols["client_tag"]))
+                if hard > 0:
+                    depth = w._inbox_depth()
+                    room = hard - depth
+                    if room < len(cols["client_tag"]):
+                        cols = self._door_shed(w, cols, max(0, room),
+                                               depth)
+                if len(cols["client_tag"]):
+                    w._inbox.put(cols)
         fl = self._flight
         if fl.enabled:
             # router handoff span per traced frame: native enqueue ->
@@ -2223,6 +2519,37 @@ class JanusService:
                              int(polled["client_tag"][i]))
         self.ticks += 1
         return True
+
+    def _door_shed(self, w: "JanusService", cols: Dict[str, np.ndarray],
+                   room: int, depth: int) -> Dict[str, np.ndarray]:
+        """Front-door admission for one worker's routed chunk when the
+        shard's queue is at its hard cap: safe and stable ops ALWAYS
+        enter (they are deferred at worst, never refused); unsafe ops
+        enter up to the remaining room and the newest excess is shed
+        with a retry-after nack, accounted on the worker's ledger so
+        its offered == admitted + shed stays exact."""
+        opc = cols["op_code"]
+        stable_m = np.isin(opc, self._stable_opcs)
+        safe_m = ~stable_m & (cols["is_safe"].astype(bool)
+                              | (opc == np.int32(ord("s"))))
+        unsafe_idx = np.flatnonzero(~stable_m & ~safe_m)
+        n_all = len(opc)
+        budget = max(0, room - (n_all - int(unsafe_idx.size)))
+        if unsafe_idx.size <= budget:
+            return cols
+        shed_idx = unsafe_idx[budget:] if budget else unsafe_idx
+        n_shed = int(shed_idx.size)
+        tags = cols["client_tag"][shed_idx].astype(np.uint64)
+        hard = self.cfg.inbox_hard_cap
+        ra = int(self.cfg.retry_after_ms)
+        if hard > 0:
+            ra = min(1000, ra * max(1, (depth + n_all) // hard))
+        self._nack_bulk.append((tags, f"shed: retry_after_ms={ra}"))
+        w.slo.shed_op("unsafe", n_shed)
+        w.slo.replied["unsafe"].add(n_shed)
+        keep = np.ones(n_all, bool)
+        keep[shed_idx] = False
+        return {f: v[keep] for f, v in cols.items()}
 
     def _route_shards(self, polled, data_mask: np.ndarray) -> np.ndarray:
         """Owning shard per op via shard_of(type_code, key_name). The
@@ -2505,7 +2832,12 @@ class JanusService:
     def _slo_snapshot(self) -> dict:
         """The ``/slo`` document: one SloLedger snapshot, or (sharded
         front-end) the merge_slo fold of every worker's — counters and
-        bucket vectors sum, percentiles recompute from merged counts."""
+        bucket vectors sum, percentiles recompute from merged counts.
+        Overload-control fields ride the same document: the top-level
+        ``shed`` counter, per-class ``classes[c]["shed"]`` attribution
+        (policy check: only "unsafe" may be nonzero), and the
+        ``offered == admitted + shed`` identity a scraper can assert
+        directly against the three top-level counters."""
         if self._front:
             return obs_slo.merge_slo(
                 [(f"s{k}", w.slo.snapshot())

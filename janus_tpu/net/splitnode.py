@@ -131,7 +131,7 @@ class SplitNode:
 
     def __init__(self, cfg: DagConfig, spec, ops_per_block: int,
                  owned, send: Optional[Callable[[bytes], None]] = None,
-                 **dims):
+                 key_retry_budget: int = 512, **dims):
         self.cfg = cfg
         self.spec = spec
         self.owned = np.asarray(owned, bool)
@@ -174,7 +174,14 @@ class SplitNode:
         self._prev_acks = np.zeros((w, n, n), bool)
         self._prev_ce = np.zeros((w, n), bool)
         self.stats = {"verified_ok": 0, "verified_bad": 0, "queries": 0,
-                      "stale_dropped": 0}
+                      "stale_dropped": 0, "parked_dropped": 0}
+        # bounded key-exchange wait: after this many not-ready steps (or
+        # a parked block re-parking this many times) the node stops
+        # parking forever and surfaces a DEGRADED verdict via
+        # ``degraded_reason`` — the watchdog's observe_key_exchange feed
+        self.key_retry_budget = int(key_retry_budget)
+        self._key_wait_steps = 0
+        self.degraded_reason: Optional[str] = None
 
     # -- crypto ----------------------------------------------------------
 
@@ -294,7 +301,9 @@ class SplitNode:
             return
         if src not in self.keys:
             # key exchange not finished for this peer: park and retry
-            self._pending_blocks.append((int(r), int(src), payload))
+            # (bounded — _drain_inbox ages the park and drops past the
+            # retry budget)
+            self._pending_blocks.append([int(r), int(src), payload, 0])
             return
         digest = self._digest_block(r, src, edge_bytes, ops)
         if not self._verify(int(src), digest, sig):
@@ -376,14 +385,26 @@ class SplitNode:
                     f = self._frames.get((int(r), int(src)))
                     if f:
                         self.send(f)
-        # parked blocks whose creator key arrived
+        # parked blocks whose creator key arrived; the park is BOUNDED —
+        # a block whose creator key never shows up is dropped once its
+        # age blows the retry budget (the peer is broken or hostile, and
+        # the query-repair path can refetch the block if the key ever
+        # does arrive), instead of growing the park list forever
         if self._pending_blocks:
             parked, self._pending_blocks = self._pending_blocks, []
-            for r, src, payload in parked:
+            for item in parked:
+                r, src, payload, age = item
                 if src in self.keys:
                     self._handle_block(payload, acc)
+                elif age + 1 >= self.key_retry_budget:
+                    self.stats["parked_dropped"] += 1
+                    self.log.warning(
+                        "dropping block parked for missing key (round "
+                        "%d, source %d) after %d retries", r, src,
+                        age + 1)
                 else:
-                    self._pending_blocks.append((r, src, payload))
+                    item[3] = age + 1
+                    self._pending_blocks.append(item)
 
     def _settle_pending(self, acc) -> None:
         """Verify parked sigs/certs whose block digest is now known;
@@ -610,8 +631,24 @@ class SplitNode:
             # the query-repair path only fires for digest-UNKNOWN blocks
             for r, s, e, rows in acc["blocks"]:
                 self._parked_blocks.setdefault((r, s), (e, rows))
+            # bounded wait: keep retrying the init broadcast, but once
+            # the budget blows surface a DEGRADED verdict instead of
+            # parking silently forever (the service feeds this to the
+            # watchdog every step)
+            self._key_wait_steps += 1
+            if self._key_wait_steps >= self.key_retry_budget:
+                missing = sorted(set(range(self.cfg.num_nodes))
+                                 - set(self.keys))
+                self.degraded_reason = (
+                    f"key exchange incomplete after "
+                    f"{self._key_wait_steps} steps "
+                    f"(missing nodes {missing})")
             self.send(self._init_frames())
             return None
+        if self.degraded_reason is not None or self._key_wait_steps:
+            # exchange completed: clear the verdict and re-arm
+            self.degraded_reason = None
+            self._key_wait_steps = 0
         self._settle_pending(acc)
         self._ingest(acc)
         # measured wire-ingest leg: frame parse + signature verify +

@@ -16,7 +16,8 @@ from __future__ import annotations
 import json
 import re
 
-from janus_tpu.obs.metrics import BUCKET_HI, get_registry
+from janus_tpu.obs.metrics import (BUCKET_HI, Counter, Gauge, Histogram,
+                                   get_registry)
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -38,39 +39,44 @@ def snapshot_json(registry=None, extra=None) -> str:
 
 
 def render_prometheus(registry=None) -> str:
-    """Registry in Prometheus text exposition format."""
+    """Registry in Prometheus text exposition format.
+
+    Renders from raw instrument state, NOT ``Registry.snapshot()``: the
+    snapshot computes p50/p90/p99 per histogram, which this format never
+    carries (scrape-side ``histogram_quantile`` recomputes them from the
+    buckets). On a registry with dozens of histograms those wasted rank
+    passes dominated the per-scrape cost billed to ``obs_http_cpu_ns``.
+    """
     reg = registry if registry is not None else get_registry()
     lines = []
-    for name, snap in sorted(reg.snapshot().items()):
+    for name in reg.names():
+        inst = reg.get(name)
         pname = _sanitize(name)
-        kind = snap["type"]
-        if kind == "counter":
+        if isinstance(inst, Counter):
             lines.append(f"# HELP {pname} Monotonic counter {name}")
             lines.append(f"# TYPE {pname} counter")
-            lines.append(f"{pname} {snap['value']}")
-        elif kind == "gauge":
+            lines.append(f"{pname} {inst.value}")
+        elif isinstance(inst, Gauge):
             lines.append(f"# HELP {pname} Gauge {name}")
             lines.append(f"# TYPE {pname} gauge")
-            lines.append(f"{pname} {_fmt(snap['value'])}")
-        else:
-            unit = snap.get("unit", "ns")
+            lines.append(f"{pname} {_fmt(inst.value)}")
+        elif isinstance(inst, Histogram):
             lines.append(f"# HELP {pname} Histogram {name} "
-                         f"(power-of-two buckets, unit {unit})")
+                         f"(power-of-two buckets, unit {inst.unit})")
             lines.append(f"# TYPE {pname} histogram")
-            buckets = snap["buckets"]
+            counts = inst.counts()
             # every edge through the max observed bucket, zero-count
             # edges included — clients interpolate between adjacent
             # emitted edges, so a skipped empty edge merges octaves
-            max_i = max((i for i, hi in enumerate(BUCKET_HI)
-                         if buckets.get(str(hi), 0)), default=-1)
+            max_i = max((i for i, c in enumerate(counts) if c),
+                        default=-1)
             cum = 0
             for i in range(max_i + 1):
-                hi = BUCKET_HI[i]
-                cum += buckets.get(str(hi), 0)
-                lines.append(f'{pname}_bucket{{le="{hi}"}} {cum}')
-            lines.append(f'{pname}_bucket{{le="+Inf"}} {snap["count"]}')
-            lines.append(f"{pname}_sum {snap['sum']}")
-            lines.append(f"{pname}_count {snap['count']}")
+                cum += counts[i]
+                lines.append(f'{pname}_bucket{{le="{BUCKET_HI[i]}"}} {cum}')
+            lines.append(f'{pname}_bucket{{le="+Inf"}} {inst.count}')
+            lines.append(f"{pname}_sum {inst.sum}")
+            lines.append(f"{pname}_count {inst.count}")
     return "\n".join(lines) + "\n"
 
 

@@ -312,10 +312,15 @@ def shard_instruments(shard: int, registry: Optional[Registry] = None) -> dict:
     - ``shard{K}_inbox_hwm``   gauge (ratcheted via ``Gauge.max``): the
       deepest worker K's ingress — Python inbox or native ring — has
       ever been; bounded-growth evidence for the inbox-cap audit
-    - ``shard{K}_inbox_overflow_total`` counter: observations of depth
-      past the configured soft cap. A sensor, not a drop count — the
-      service sheds nothing yet, so the SLO ``shed`` counter staying
-      zero while this climbs is the admission-control to-do signal.
+    - ``shard{K}_inbox_overflow_ops_total`` counter: OPS that arrived
+      while depth sat past the configured soft cap (magnitude of the
+      pressure). Still a sensor, not a drop count — shedding is the
+      hard cap's job and is accounted in the SLO ``shed`` counters.
+    - ``shard{K}_inbox_overflow_episodes_total`` counter:
+      edge-triggered — bumps ONCE each time depth crosses the soft cap
+      from below, so one sustained burst counts as one episode no
+      matter how many ops rode it (the old ``..._overflow_total``
+      conflated the two).
 
     ``render_prometheus`` emits ``# HELP``/``# TYPE`` lines for these
     like any other instrument.
@@ -326,5 +331,8 @@ def shard_instruments(shard: int, registry: Optional[Registry] = None) -> dict:
         "queue_depth": reg.gauge(f"shard{shard}_queue_depth"),
         "step_lag": reg.gauge(f"shard{shard}_step_lag_ms"),
         "inbox_hwm": reg.gauge(f"shard{shard}_inbox_hwm"),
-        "inbox_overflow": reg.counter(f"shard{shard}_inbox_overflow_total"),
+        "inbox_overflow_ops": reg.counter(
+            f"shard{shard}_inbox_overflow_ops_total"),
+        "inbox_overflow_episodes": reg.counter(
+            f"shard{shard}_inbox_overflow_episodes_total"),
     }

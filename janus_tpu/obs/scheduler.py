@@ -19,6 +19,27 @@ target; the owner calls ``SafeKV.resize_block`` which may refuse a
 shrink while tail lanes still carry live ops (the target is then
 retried at the next adjust tick). Blocks quantize to ``quantum`` lanes
 so XLA retraces happen at a handful of shapes, not per-adjust.
+
+With ``slo_p99_target_ms > 0`` the same controller closes the FULL
+overload loop: ``observe_slo`` feeds it the live SloLedger's evidence
+(goodput, unsafe p99, queue depth as a fraction of the hard cap) and
+``maybe_adjust`` co-schedules, at the same cadence, the block size
+(above), the drain hold-off ``wait_ms``, and the unsafe-class shed
+probability ``shed_prob``:
+
+- queue at/past the hard cap, or p99 past target while queued deep:
+  multiplicative shed increase (drain the queue within a few windows,
+  before p99 integrates it) and hold-off pinned long — deep queues
+  fill every drain anyway, so batching is free goodput;
+- p99 past target while queues are shallow: the latency is self-made —
+  shrink the hold-off toward ``wait_min_ms`` instead of shedding;
+- healthy: multiplicative shed decay to zero and hold-off relaxation
+  back to the configured operating point ``wait0_ms``.
+
+A goodput guard bounds the shed law: while measured goodput sits below
+90% of its (decaying) peak, shed probability holds rather than grows —
+shedding harder once goodput is already collapsing trades throughput
+for nothing.
 """
 from __future__ import annotations
 
@@ -38,6 +59,14 @@ class SchedulerConfig:
     shrink_factor: float = 0.5      # multiplicative decrease per adjust
     adjust_every: int = 8           # ticks between decisions
     quantum: int = 64               # B rounded down to a multiple
+    # SLO-driven overload extension (inactive at 0.0): unsafe e2e p99
+    # the shed/wait laws defend, read from the live SloLedger via
+    # observe_slo
+    slo_p99_target_ms: float = 0.0
+    shed_max: float = 0.95          # unsafe shed-probability ceiling
+    wait0_ms: float = 10.0          # healthy-state drain hold-off
+    wait_min_ms: float = 1.0        # latency-mode hold-off floor
+    wait_max_ms: float = 50.0       # overload-mode hold-off ceiling
 
     def bound(self) -> int:
         """Largest B the ring window tolerates."""
@@ -63,6 +92,14 @@ class AdaptiveTick:
         self._seal_ms = []
         self._overflows = 0
         self._dirty_fracs = []
+        # overload-control outputs (live values the owner actuates);
+        # inert unless cfg.slo_p99_target_ms > 0 and observe_slo feeds
+        self.shed_prob = 0.0
+        self.wait_ms = float(cfg.wait0_ms)
+        self._slo_obs = []  # (goodput_ops_s, p99_ms, depth_frac)
+        self._goodput_peak = 0.0
+        self._g_shed = reg.gauge(f"{scope}_shed_prob_ppm")
+        self._g_wait = reg.gauge(f"{scope}_ingest_wait_us")
 
     @property
     def b(self) -> int:
@@ -92,6 +129,61 @@ class AdaptiveTick:
         if overflowed:
             self._overflows += 1
 
+    def observe_slo(self, goodput_ops_s: float, p99_ms: float,
+                    depth_frac: float) -> None:
+        """One tick's SLO-plane evidence: admitted-goodput over the last
+        window, unsafe e2e p99, and queue depth as a fraction of the
+        admission hard cap (>= 1.0 means the door is past its cap)."""
+        self._slo_obs.append(
+            (float(goodput_ops_s), float(p99_ms), float(depth_frac)))
+
+    def _adjust_slo(self) -> None:
+        """Shed/wait half of the adjust step (slo mode only)."""
+        obs = self._slo_obs
+        self._slo_obs = []
+        if not obs or self.cfg.slo_p99_target_ms <= 0:
+            return
+        goodput = sum(g for g, _p, _d in obs) / len(obs)
+        p99 = max(p for _g, p, _d in obs)
+        depth = max(d for _g, _p, d in obs)
+        target = self.cfg.slo_p99_target_ms
+        # decaying peak: the reference the goodput guard compares
+        # against adapts if the sustainable rate itself moves
+        self._goodput_peak = max(goodput, self._goodput_peak * 0.98)
+        if depth >= 1.0 or (p99 > target and depth >= 0.5):
+            # overloaded at the door: shed multiplicatively while
+            # goodput holds near its peak. Once goodput falls below
+            # 90% of peak, shedding is eating admitted work — back
+            # off multiplicatively instead, so the law seeks the shed
+            # level that keeps goodput on the plateau rather than
+            # overshooting and pinning there
+            if goodput < 0.9 * self._goodput_peak:
+                self.shed_prob *= 0.7
+                if self.shed_prob < 0.02:
+                    self.shed_prob = 0.0
+            else:
+                self.shed_prob = min(self.cfg.shed_max,
+                                     self.shed_prob * 1.7 + 0.05)
+            # deep queues fill every drain: long hold-off is free
+            # batching, so pin it at the ceiling
+            self.wait_ms = self.cfg.wait_max_ms
+        elif p99 > target:
+            # slow but shallow: the hold-off itself is the latency —
+            # halve it toward the floor instead of shedding
+            self.wait_ms = max(self.cfg.wait_min_ms, self.wait_ms * 0.5)
+            self.shed_prob *= 0.5
+            if self.shed_prob < 0.02:
+                self.shed_prob = 0.0
+        else:
+            self.shed_prob *= 0.5
+            if self.shed_prob < 0.02:
+                self.shed_prob = 0.0
+            # relax the hold-off back to the operating point
+            w0 = self.cfg.wait0_ms
+            self.wait_ms += (w0 - self.wait_ms) * 0.5
+        self._g_shed.set(int(self.shed_prob * 1e6))
+        self._g_wait.set(int(self.wait_ms * 1e3))
+
     def maybe_adjust(self):
         """At the adjust cadence, return a new target B (or None)."""
         if self._ticks < self.cfg.adjust_every:
@@ -105,6 +197,7 @@ class AdaptiveTick:
         self._seal_ms = []
         self._overflows = 0
         self._dirty_fracs = []
+        self._adjust_slo()
         if not seal:
             return None
         seal_sorted = sorted(seal)

@@ -19,10 +19,13 @@ never what a client actually waits. This module closes that gap:
 - Offered / admitted / replied / shed counters make goodput and shed
   rate first-class instruments instead of harness post-processing:
   *offered* = ops handed to the service instance (router-side per
-  shard), *admitted* = ops its step loop drained, *replied* = data
-  replies sent per class, *shed* = ops dropped by admission control
-  (always 0 until the overload controller lands; the instrument exists
-  so the controller has somewhere to account).
+  shard), *admitted* = ops its step loop accepted for execution,
+  *shed* = ops the admission controller refused with a retry-after
+  nack (unsafe class only — safe/stable ops are deferred, never shed).
+  The ledger holds ``offered == admitted + shed`` exactly: every
+  offered op is accounted on exactly one side, and every shed op still
+  gets a (nack) reply, so ``replied_total`` reconciles with ``offered``
+  once the queue drains.
 
 Everything lands in the process-wide metrics registry (names carry the
 ledger's ``scope`` — the service's per-shard ``_s{K}`` suffix), so the
@@ -88,6 +91,13 @@ class SloLedger:
         self.offered = reg.counter(f"slo{scope}_offered_total")
         self.admitted = reg.counter(f"slo{scope}_admitted_total")
         self.shed = reg.counter(f"slo{scope}_shed_total")
+        # per-class shed attribution: policy says only "unsafe" ever
+        # sheds, but the ledger records all three so a policy bug shows
+        # up as a nonzero safe/stable shed counter, not silence
+        self.shed_by_class: Dict[str, object] = {
+            c: reg.counter(f"slo{scope}_shed_{c}_total")
+            for c in OP_CLASSES
+        }
         self.replied: Dict[str, object] = {
             c: reg.counter(f"slo{scope}_replied_{c}_total")
             for c in OP_CLASSES
@@ -135,6 +145,15 @@ class SloLedger:
             return
         now = time.monotonic_ns() if now_ns is None else now_ns
         self.e2e[cls].record_many(now - t0[stamped])
+
+    def shed_op(self, cls: str, n: int = 1) -> None:
+        """Account ``n`` ops refused by admission control (they get a
+        retry-after nack instead of execution). Keeps the aggregate and
+        the per-class counters in lockstep so ``offered == admitted +
+        shed`` stays checkable from either view."""
+        if n > 0:
+            self.shed.add(n)
+            self.shed_by_class[cls].add(n)
 
     # -- segment sampling -----------------------------------------------
 
@@ -189,6 +208,7 @@ class SloLedger:
                 }
             classes[c] = {
                 "replied": int(self.replied[c].value),
+                "shed": int(self.shed_by_class[c].value),
                 "e2e_samples": h.count,
                 "e2e_p50_ms": round(h.percentile(0.50) / 1e6, 3),
                 "e2e_p99_ms": round(h.percentile(0.99) / 1e6, 3),
@@ -227,7 +247,8 @@ def merge_slo(parts: List[Tuple[str, dict]], scope: str = "") -> dict:
                   for c in OP_CLASSES}
     seg_meta = {c: {s: {"samples": 0, "sum_ns": 0} for s in SEGMENTS}
                 for c in OP_CLASSES}
-    classes = {c: {"replied": 0, "e2e_samples": 0, "e2e_sum_ns": 0}
+    classes = {c: {"replied": 0, "shed": 0, "e2e_samples": 0,
+                   "e2e_sum_ns": 0}
                for c in OP_CLASSES}
     out = {"scope": scope, "offered": 0, "admitted": 0, "shed": 0,
            "unstamped": 0, "untraced": 0, "replied_total": 0, "nodes": {}}
@@ -238,6 +259,7 @@ def merge_slo(parts: List[Tuple[str, dict]], scope: str = "") -> dict:
         for c in OP_CLASSES:
             cs = (snap.get("classes") or {}).get(c) or {}
             classes[c]["replied"] += int(cs.get("replied", 0))
+            classes[c]["shed"] += int(cs.get("shed", 0))
             classes[c]["e2e_samples"] += int(cs.get("e2e_samples", 0))
             classes[c]["e2e_sum_ns"] += int(cs.get("e2e_sum_ns", 0))
             vec = cs.get("counts")

@@ -17,6 +17,15 @@ owner's tick loop (service pump, harness driver):
   silently running full converges.
 - **equivocation** — integrity verification pruned more than
   ``equivocation_limit`` blocks from one source node.
+- **shed storm** — the admission controller shed at least
+  ``shed_storm_frac`` of offered ops on ``shed_storm_ticks``
+  consecutive observations: the cluster is sustainedly refusing a
+  large share of its load, which is working-as-designed under a flood
+  but is an operator page, not a silent steady state.
+- **key exchange** — a split-cluster peer has not completed key
+  exchange within its retry budget (net/splitnode.py surfaces the
+  verdict through ``observe_key_exchange``), so blocks from/with that
+  peer park instead of verifying.
 
 Each detector is edge-triggered: on the tick an anomaly first becomes
 active the watchdog dumps the process flight recorder to
@@ -47,6 +56,8 @@ class WatchdogConfig:
     recompile_limit: int = 3      # rises within the window -> storm
     overflow_streak: int = 16     # consecutive overflow ticks -> DEGRADED
     equivocation_limit: int = 0   # pruned blocks tolerated per node
+    shed_storm_ticks: int = 16    # consecutive heavy-shed ticks -> DEGRADED
+    shed_storm_frac: float = 0.5  # shed/offered ratio that counts as heavy
     dump_dir: Optional[str] = None  # None -> never write dump files
     # dump-file qualifier for instances SHARING a dump_dir (shard
     # workers, split-cluster processes): each watchdog counts its own
@@ -73,6 +84,10 @@ class HealthWatchdog:
         # overflow-streak state, per scope
         self._last_overflows: Dict[str, int] = {}
         self._overflow_run: Dict[str, int] = {}
+        # shed-storm state, per scope (cumulative-counter deltas)
+        self._last_shed: Dict[str, int] = {}
+        self._last_offered: Dict[str, int] = {}
+        self._shed_run: Dict[str, int] = {}
         # equivocation state
         self._equiv: Dict[int, int] = {}
         self._active: Dict[str, str] = {}  # anomaly key -> reason
@@ -126,6 +141,46 @@ class HealthWatchdog:
             self._raise(key, DEGRADED,
                         f"{scope}: delta budget overflowed "
                         f"{n} consecutive ticks")
+
+    def observe_shed(self, scope: str, shed_total: int,
+                     offered_total: int) -> None:
+        """Feed the cumulative SLO shed/offered counters once per tick.
+        A tick counts toward the storm when the tick's shed delta is at
+        least ``shed_storm_frac`` of its offered delta; idle ticks
+        (nothing offered) neither extend nor reset the streak — a storm
+        is about the ticks that carried load."""
+        key = f"shed_storm:{scope}"
+        last_s = self._last_shed.get(scope)
+        last_o = self._last_offered.get(scope, 0)
+        self._last_shed[scope] = int(shed_total)
+        self._last_offered[scope] = int(offered_total)
+        if last_s is None:
+            return
+        ds = int(shed_total) - last_s
+        do = int(offered_total) - last_o
+        if do <= 0:
+            return
+        if ds > 0 and ds >= self.cfg.shed_storm_frac * do:
+            n = self._shed_run.get(scope, 0) + 1
+            self._shed_run[scope] = n
+            if n >= self.cfg.shed_storm_ticks:
+                self._raise(key, DEGRADED,
+                            f"{scope}: shed {ds}/{do} offered ops, "
+                            f"{n} consecutive loaded ticks")
+        else:
+            self._shed_run[scope] = 0
+            self._clear(key)
+
+    def observe_key_exchange(self, scope: str,
+                             reason: Optional[str]) -> None:
+        """Split-plane key-exchange verdict: a non-None ``reason`` means
+        the peer handshake blew its retry budget (DEGRADED until the
+        exchange completes and the owner reports None again)."""
+        key = f"key_exchange:{scope}"
+        if reason:
+            self._raise(key, DEGRADED, f"{scope}: {reason}")
+        else:
+            self._clear(key)
 
     def observe_equivocation(self, counts: Dict[int, int]) -> None:
         """Per-source pruned-block counts from the integrity plane."""

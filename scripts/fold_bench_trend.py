@@ -71,6 +71,7 @@ def fold_trend(root: str) -> Dict[int, dict]:
         if rnd is None:
             continue
         wire_best = multi_best = None
+        ovl_sat = ovl_shed = None
         try:
             lines = open(path).read().splitlines()
         except OSError:
@@ -90,11 +91,26 @@ def fold_trend(root: str) -> Dict[int, dict]:
             agg = r.get("aggregate_goodput_ops_per_sec")
             if agg:
                 multi_best = max(multi_best or 0.0, float(agg))
+            # overload sweep rows: goodput AND shed fraction at the
+            # DEEPEST offered-load point — the "does admission control
+            # hold goodput at saturation" trend pair
+            ov = r.get("overload_report") or {}
+            sweep = ov.get("sweep") or []
+            if sweep:
+                deepest = max(sweep, key=lambda p: float(p.get("mult", 0)))
+                g = float(deepest.get("goodput_ops_per_sec", 0.0))
+                if ovl_sat is None or g > ovl_sat:
+                    ovl_sat = g
+                    ovl_shed = (float(deepest.get("shed", 0))
+                                / max(float(deepest.get("offered", 0)), 1.0))
         row = _row(rnd)
         if wire_best is not None:
             row["wire_goodput_ops_per_sec"] = wire_best
         if multi_best is not None:
             row["multihost_goodput_ops_per_sec"] = multi_best
+        if ovl_sat is not None:
+            row["overload_goodput_at_saturation_ops_per_sec"] = ovl_sat
+            row["overload_shed_fraction"] = ovl_shed
     return rows
 
 
@@ -106,6 +122,9 @@ _COLUMNS = (
     ("safe_colocated_p50_ms", "colocated p50 ms", "{:.2f}"),
     ("wire_goodput_ops_per_sec", "wire goodput ops/s", "{:,.0f}"),
     ("multihost_goodput_ops_per_sec", "multihost ops/s", "{:,.0f}"),
+    ("overload_goodput_at_saturation_ops_per_sec",
+     "overload goodput@sat ops/s", "{:,.0f}"),
+    ("overload_shed_fraction", "shed@sat", "{:.1%}"),
 )
 
 

@@ -61,6 +61,19 @@ def _smoke_cfg(name, cfg):
     elif cfg.mode == "wire_native":
         over = dict(num_objects=32, ops_per_block=256, clients=2,
                     ops_per_client=3000, pipeline=64)
+    elif cfg.mode == "overload":
+        # two points (1x, 12x) against a tiny calibrated capacity: the
+        # admission door, safe lanes, controller, and ledger
+        # reconciliation all engage; the smoke_overload row gates on
+        # the recorded sweep (goodput holds past saturation, zero safe
+        # sheds, exact offered == admitted + shed, controller overhead
+        # < 2%). The deep point is 12x, not 4x: burst-regime
+        # calibration understates true capacity severalfold, and the
+        # deep point must land far enough past TRUE capacity that the
+        # door reliably sheds (the smoke asserts shed > 0 there)
+        over = dict(num_objects=16, ops_per_block=64, clients=2,
+                    ops_per_client=8192, frame_ops=256,
+                    load_mults=(1.0, 12.0))
     elif cfg.mode in ("wire_sharded", "wire_sharded_native"):
         # both A/B arms run the same shrunken schedule; the run's own
         # bit-equality gate (sharded vs unsharded final state, or
@@ -399,6 +412,7 @@ def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
     failures = []
     slo_payload = None  # the wire_sharded preset's row, for the SLO gate
     nat_payload = None  # the wire_sharded_native row, for the anatomy gate
+    ovl_payload = None  # the overload preset's row, for the overload gate
     with open(out_path, "a") as f:
         for name in sorted(PRESETS):
             cfg = _smoke_cfg(name, PRESETS[name])
@@ -429,6 +443,8 @@ def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
                 failures.append((name, overhead))
             if cfg.mode == "wire_sharded":
                 slo_payload = payload
+            if cfg.mode == "overload":
+                ovl_payload = payload
             if cfg.mode == "wire_sharded_native":
                 nat_payload = payload
                 # demux gates: the native ring must reproduce the
@@ -620,12 +636,68 @@ def run_smoke(out_path: str, overhead_budget: float = 0.02) -> None:
             cov_ns = float((an.get(c) or {}).get("coverage_ns", 0.0))
             if cov < 0.95 and abs(cov_ns - 1.0) > 0.05:
                 failures.append((f"anatomy({c} coverage)", cov))
+
+        # overload-control row: gate the closed control loop on the
+        # overload preset's sweep captured in the loop above (no
+        # re-run). The sweep itself hard-asserts exact per-point
+        # offered == admitted + shed reconciliation and zero
+        # safe/stable sheds; this row re-checks them from the RECORDED
+        # report (so a silent assert regression can't pass the smoke)
+        # and adds the goodput gate: the deepest point must hold >= 90%
+        # of the 1x point's goodput — admission control means overload
+        # plateaus goodput instead of collapsing it — with the SLO
+        # controller's own cost under the telemetry budget.
+        ov = (ovl_payload or {}).get("overload_report") or {}
+        sweep = {float(p.get("mult", 0)): p for p in ov.get("sweep", ())}
+        g1 = float((sweep.get(1.0) or {}).get("goodput_ops_per_sec", 0.0))
+        deep_m = max(sweep, default=0.0)
+        gd = float((sweep.get(deep_m) or {}).get(
+            "goodput_ops_per_sec", 0.0))
+        recon_bad = sum(
+            1 for p in ov.get("sweep", ())
+            if int(p["offered"]) != int(p["admitted"]) + int(p["shed"]))
+        ovl_cost = float(ov.get("controller_overhead_frac_max", 1.0))
+        # NB: `payload` still holds the anatomy row (the closing
+        # "# smoke OK" print reads its coverage) — use a fresh name
+        ovl_row = {
+            "run": "smoke_overload",
+            "ts": round(time.time(), 1),
+            "config": (ovl_payload or {}).get("config", "?"),
+            "overload_report": ov,
+            "smoke": {
+                "goodput_1x": g1,
+                "deep_mult": deep_m,
+                "goodput_deep": gd,
+                "goodput_ratio": round(gd / max(g1, 1e-9), 4),
+                "points_reconciled": len(sweep) - recon_bad,
+                "controller_overhead_frac_max": ovl_cost,
+            },
+        }
+        line = json.dumps(ovl_row)
+        print(line, flush=True)
+        f.write(line + "\n")
+        f.flush()
+        for gate, bad, frac in (
+                ("overload(no sweep points)", not sweep, 1.0),
+                ("overload(goodput collapsed past saturation)",
+                 gd < 0.9 * g1, gd / max(g1, 1e-9)),
+                ("overload(ledger reconciliation)",
+                 recon_bad > 0, float(recon_bad)),
+                ("overload(safe/stable ops shed)",
+                 int(ov.get("safe_shed_total", 1)) != 0
+                 or int(ov.get("stable_shed_total", 1)) != 0, 1.0),
+                ("overload(commit stalls)",
+                 int(ov.get("commit_stalls", 1)) != 0, 1.0),
+                ("overload(controller overhead)",
+                 ovl_cost >= overhead_budget, ovl_cost)):
+            if bad:
+                failures.append((gate, frac))
     if failures:
         raise AssertionError(
             "smoke gates failed (telemetry fast path / SLO plane): "
             + ", ".join(f"{n}: {100 * o:.2f}%" for n, o in failures))
     print(f"# smoke OK: {len(PRESETS)} presets + flight tracing + SLO "
-          f"plane + latency anatomy, overhead < "
+          f"plane + latency anatomy + overload control, overhead < "
           f"{100 * overhead_budget:.0f}% (flight < 3%); oob scrape "
           f"cpu_frac {oob.get('cpu_frac', '?')}; anatomy coverage "
           f"{payload['smoke']['coverage_p50']}", flush=True)

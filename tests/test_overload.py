@@ -1,0 +1,423 @@
+"""Overload-control plane tests: admission at the door, priority lanes,
+retry-after nacks, and the client half of the loop.
+
+The policy under test (service._shed_unsafe / service._door_shed):
+safe- and stable-class ops are NEVER shed at any depth — overload
+defers them, it does not refuse them — while unsafe ops past the hard
+cap (or sampled by the controller's live shed probability) are refused
+with a ``shed: retry_after_ms=N`` nack that rides the ordinary err
+reply, so pre-overload (v1/v2) clients degrade to a plain nack while
+upgraded clients parse the hint. Every shed op stays on the ledger
+(offered, never admitted), so ``offered == admitted + shed`` holds
+exactly at every call site.
+"""
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from janus_tpu.net import JanusClient, JanusConfig, JanusService, TypeConfig
+from janus_tpu.net.client import (
+    SHED_PREFIX,
+    BatchSender,
+    parse_retry_after,
+)
+from janus_tpu.net.service import _POLL_FIELDS, _ShardInbox
+
+
+# -- retry-after parsing (wire-compat contract) ---------------------------
+
+def test_parse_retry_after():
+    assert parse_retry_after("shed: retry_after_ms=25") == 25
+    # trailing text after the integer is tolerated (future servers may
+    # append detail without breaking old parsers)
+    assert parse_retry_after("shed: retry_after_ms=200 (door full)") == 200
+    # a v1/v2-style plain nack is NOT a shed — None, not a crash
+    assert parse_retry_after("error: unknown key") is None
+    assert parse_retry_after("") is None
+    # prefix without digits is malformed -> not a shed hint
+    assert parse_retry_after(SHED_PREFIX) is None
+    assert parse_retry_after(SHED_PREFIX + "x") is None
+
+
+# -- _ShardInbox overflow split counters ----------------------------------
+
+def _chunk(n, tag0=0):
+    cols = {f: np.zeros(n, dt) for f, dt in _POLL_FIELDS}
+    cols["client_tag"] = np.arange(tag0, tag0 + n, dtype=np.uint64)
+    return cols
+
+
+def test_inbox_overflow_ops_vs_episodes():
+    """overflow_ops counts pressure magnitude (every op put past the
+    soft cap), overflow_episodes counts crossings (edge-triggered,
+    re-armed by drain) — one burst is one episode however many ops it
+    parks."""
+    ib = _ShardInbox(soft_cap=10)
+    ib.put(_chunk(8))
+    assert ib.overflow_ops == 0 and ib.overflow_episodes == 0
+    ib.put(_chunk(4))   # depth 12: crossed
+    ib.put(_chunk(4))   # depth 16: still the same episode
+    assert ib.overflow_ops == 8
+    assert ib.overflow_episodes == 1
+    assert ib.hwm == 16
+    drained = ib.drain()
+    assert len(drained["client_tag"]) == 16
+    assert ib.depth == 0
+    # drain re-armed the edge: the next crossing is a NEW episode
+    ib.put(_chunk(11))
+    assert ib.overflow_ops == 19
+    assert ib.overflow_episodes == 2
+    assert ib.hwm == 16  # high watermark remembers the deepest burst
+    # soft cap never sheds: every op put was handed back by drain
+    assert len(ib.drain()["client_tag"]) == 11
+    # empty drain keeps the poll-column shape (fields AND dtypes)
+    empty = ib.drain()
+    for f, dt in _POLL_FIELDS:
+        assert empty[f].dtype == dt and len(empty[f]) == 0
+
+
+# -- shed policy units (real service objects, no sockets) -----------------
+
+@pytest.fixture()
+def sharded_svc():
+    """A sharded front + workers, CONSTRUCTED but never started: the
+    shed policy methods are pure column transforms over service state,
+    so they are testable without a socket or a device step."""
+    svc = JanusService(JanusConfig(
+        num_nodes=4, window=8, ops_per_block=8, shards=2,
+        native_demux=False, inbox_hard_cap=16, retry_after_ms=25,
+        types=(TypeConfig("pnc", {"num_keys": 8}),)))
+    yield svc
+    svc.stop()
+
+
+def _mixed_poll(svc):
+    """10 ops: tags 0-3 and 8-9 unsafe updates, tag 4 a flagged-safe
+    update, tag 5 a create (safe by op code), tags 6-7 stable reads
+    (packed two-letter codes)."""
+    cols = _chunk(10)
+    opc = np.full(10, ord("i"), np.int32)
+    opc[5] = ord("s")
+    opc[6] = ord("g") | (ord("s") << 8)
+    opc[7] = ord("s") | (ord("s") << 8)
+    cols["op_code"] = opc
+    is_safe = np.zeros(10, np.uint8)
+    is_safe[4] = 1
+    cols["is_safe"] = is_safe
+    return cols
+
+
+def _ledger(w):
+    return (int(w.slo.offered.value), int(w.slo.admitted.value),
+            int(w.slo.shed.value),
+            {c: int(ctr.value) for c, ctr in w.slo.shed_by_class.items()})
+
+
+def test_shed_unsafe_over_hard_cap_spares_safe_and_stable(sharded_svc):
+    w = sharded_svc.workers[0]
+    _off0, _adm0, shed0, by0 = _ledger(w)
+    kept, n_shed = w._shed_unsafe(_mixed_poll(sharded_svc), door_depth=33)
+    assert n_shed == 6
+    # survivors: the flagged-safe op, the create, both stable reads
+    assert kept["client_tag"].tolist() == [4, 5, 6, 7]
+    # one bulk nack carrying exactly the shed tags; the hint scales
+    # with how far past the cap the door sits (33/16 -> 3x base 25)
+    tags, payload = w._nack_bulk[-1]
+    assert sorted(tags.tolist()) == [0, 1, 2, 3, 8, 9]
+    assert parse_retry_after(payload) == 75
+    # ledger: unsafe sheds only, counted as replied (the nack IS the
+    # reply), never admitted
+    _off1, _adm1, shed1, by1 = _ledger(w)
+    assert shed1 - shed0 == 6
+    assert by1["unsafe"] - by0["unsafe"] == 6
+    assert by1["safe"] == by0["safe"]
+    assert by1["stable"] == by0["stable"]
+    w._nack_bulk.clear()
+
+
+def test_shed_unsafe_over_cap_sheds_only_excess(sharded_svc):
+    # depth 20 vs cap 16: only the 4 ops OVER the cap are shed (newest
+    # unsafe first) — the rest were legitimately admitted by the door,
+    # and refusing them too would collapse goodput under sustained
+    # load instead of holding it at capacity
+    w = sharded_svc.workers[0]
+    shed0 = int(w.slo.shed.value)
+    kept, n_shed = w._shed_unsafe(_mixed_poll(sharded_svc), door_depth=20)
+    assert n_shed == 4
+    assert kept["client_tag"].tolist() == [0, 1, 4, 5, 6, 7]
+    tags, payload = w._nack_bulk[-1]
+    assert sorted(tags.tolist()) == [2, 3, 8, 9]
+    # hint still scales with the overshoot: ceil(20/16) = 2x base 25
+    assert parse_retry_after(payload) == 50
+    assert int(w.slo.shed.value) - shed0 == 4
+    w._nack_bulk.clear()
+
+
+def test_shed_unsafe_probability_thins_newest_tail(sharded_svc):
+    w = sharded_svc.workers[1]
+    w._shed_prob = 0.5
+    cols = _chunk(6)
+    cols["op_code"] = np.full(6, ord("i"), np.int32)
+    kept, n_shed = w._shed_unsafe(cols, door_depth=0)
+    # floor(6 * 0.5) = 3 shed, and the admitted prefix keeps FIFO
+    # order: the NEWEST arrivals are the ones asked to retry
+    assert n_shed == 3
+    assert kept["client_tag"].tolist() == [0, 1, 2]
+    tags, payload = w._nack_bulk[-1]
+    assert sorted(tags.tolist()) == [3, 4, 5]
+    # below the cap the hint stays at the configured base
+    assert parse_retry_after(payload) == 25
+    w._nack_bulk.clear()
+    w._shed_prob = 0.0
+
+
+def test_shed_unsafe_noop_below_cap_without_probability(sharded_svc):
+    w = sharded_svc.workers[0]
+    shed0 = int(w.slo.shed.value)
+    cols = _mixed_poll(sharded_svc)
+    kept, n_shed = w._shed_unsafe(cols, door_depth=16)  # at, not past
+    assert n_shed == 0 and kept is cols
+    assert int(w.slo.shed.value) == shed0
+    assert not w._nack_bulk
+
+
+def test_door_shed_admits_safe_and_stable_before_unsafe(sharded_svc):
+    """Priority lanes at the front door: with room for 6 of 10 routed
+    ops, all 4 safe/stable ops enter and the unsafe budget is what is
+    left — the newest unsafe excess is shed."""
+    svc = sharded_svc
+    w = svc.workers[0]
+    _off0, _adm0, shed0, by0 = _ledger(w)
+    kept = svc._door_shed(w, _mixed_poll(svc), room=6, depth=12)
+    # budget for unsafe = 6 - 4 non-unsafe = 2: oldest two unsafe
+    # (tags 0, 1) enter with every safe/stable op
+    assert kept["client_tag"].tolist() == [0, 1, 4, 5, 6, 7]
+    tags, payload = svc._nack_bulk[-1]
+    assert sorted(tags.tolist()) == [2, 3, 8, 9]
+    # (depth + chunk) / hard = 22/16 -> hint stays 1x base
+    assert parse_retry_after(payload) == 25
+    _off1, _adm1, shed1, by1 = _ledger(w)
+    assert shed1 - shed0 == 4
+    assert by1["unsafe"] - by0["unsafe"] == 4
+    assert by1["safe"] == by0["safe"] and by1["stable"] == by0["stable"]
+    svc._nack_bulk.clear()
+
+
+def test_door_shed_zero_room_still_admits_safe_and_stable(sharded_svc):
+    svc = sharded_svc
+    w = svc.workers[1]
+    kept = svc._door_shed(w, _mixed_poll(svc), room=0, depth=16)
+    assert kept["client_tag"].tolist() == [4, 5, 6, 7]
+    svc._nack_bulk.clear()
+
+
+# -- BatchSender drain scan + backoff -------------------------------------
+
+def _accepted_pair():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    out = {}
+
+    def accept():
+        out["conn"], _ = srv.accept()
+
+    th = threading.Thread(target=accept)
+    th.start()
+    sender = BatchSender("127.0.0.1", srv.getsockname()[1], backoff=False)
+    th.join()
+    srv.close()
+    return sender, out["conn"]
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError("condition not reached")
+        time.sleep(0.01)
+
+
+def test_batch_sender_counts_sheds_split_across_chunks():
+    """The drain thread's substring scan must count a nack whose
+    pattern bytes straddle two recv chunks exactly once (the carry is
+    one byte short of the pattern, so it can never recount)."""
+    sender, conn = _accepted_pair()
+    pat = b"shed: retry_after_ms=40;"
+    try:
+        conn.sendall(b"\x00\x07ok" + pat[:9])
+        time.sleep(0.05)  # force a chunk boundary mid-pattern
+        conn.sendall(pat[9:])
+        _wait_for(lambda: sender.shed_replies == 1)
+        assert sender.retry_after_ms == 40
+        # two whole nacks in one chunk count as two; the freshest hint
+        # wins
+        conn.sendall(b"shed: retry_after_ms=80;shed: retry_after_ms=120;")
+        _wait_for(lambda: sender.shed_replies == 3)
+        assert sender.retry_after_ms == 120
+    finally:
+        conn.close()
+        sender.close()
+
+
+def test_batch_sender_backoff_pays_hint_then_resets():
+    sender, conn = _accepted_pair()
+    try:
+        conn.sendall(b"shed: retry_after_ms=40;")
+        _wait_for(lambda: sender.shed_replies == 1)
+        t0 = time.monotonic()
+        sender._maybe_backoff()
+        paid = time.monotonic() - t0
+        assert sender.backoff_sleeps == 1
+        # hint 40ms with +/-50% jitter: at least ~20ms actually slept
+        assert paid >= 0.015
+        # no NEW sheds since: the gate is free and the streak resets
+        t0 = time.monotonic()
+        sender._maybe_backoff()
+        assert time.monotonic() - t0 < 0.01
+        assert sender.backoff_sleeps == 1
+        assert sender._streak == 0
+    finally:
+        conn.close()
+        sender.close()
+
+
+# -- end to end: shed nack round-trip through the real wire ---------------
+
+def test_service_sheds_with_retry_hint_end_to_end():
+    """Flood one shard's door past its hard cap through the REAL
+    sharded service (python router) and read the replies back: unsafe
+    excess is nacked with a parseable retry hint riding the ordinary
+    err payload (v1/v2 clients degrade to a plain nack for free), a
+    safe op sent at full depth is deferred and eventually acked, and
+    the per-worker ledgers reconcile offered == admitted + shed."""
+    svc = JanusService(JanusConfig(
+        num_nodes=4, window=8, ops_per_block=8, shards=2,
+        native_demux=False, inbox_hard_cap=8, retry_after_ms=25,
+        types=(TypeConfig("pnc", {"num_keys": 16}),)))
+    port = svc.start(pump=False)
+
+    def pump(n=8, workers=True):
+        for _ in range(n):
+            svc.step()
+            if workers:
+                for w in svc.workers:
+                    w.step()
+            time.sleep(0.005)
+
+    try:
+        with JanusClient("127.0.0.1", port) as c:
+            seq = c.send("pnc", "acct", "s")
+            pump(8)
+            assert c.wait(seq, timeout=30)["result"] == "success"
+            pump(40)  # run the create through consensus
+
+            led0 = [_ledger(w) for w in svc.workers]
+            off_base = sum(int(w.slo.offered.value) for w in svc.workers)
+            # 64 unsafe increments on ONE key: they all route to one
+            # shard whose door (hard cap 8) admits at most 8
+            seqs = c.send_batch("pnc", ["acct"], np.zeros(64, np.int32),
+                                "i", p0=np.ones(64, np.int64))
+            for _ in range(100):  # route + nack flush, no worker drain
+                pump(1, workers=False)
+                off = sum(int(w.slo.offered.value) for w in svc.workers)
+                if off - off_base >= 64:
+                    break
+            depth = max(w._inbox_depth() for w in svc.workers)
+            assert depth == 8, "door admitted past its hard cap"
+
+            # priority lane while the queue sits AT the cap: a safe op
+            # still enters (deferred), and the shed ledger does not move
+            shed_mid = sum(int(w.slo.shed.value) for w in svc.workers)
+            safe_seq = c.send("pnc", "acct", "i", ["1"], is_safe=True)
+            pump(4, workers=False)
+            assert max(w._inbox_depth() for w in svc.workers) == 9
+            assert sum(int(w.slo.shed.value)
+                       for w in svc.workers) == shed_mid
+
+            pump(60)  # drain + commit so the deferred safe ack lands
+            by_status = {"shed": 0, "ok": 0, "err": 0, "su": 0}
+            for s in seqs:
+                rep = c.wait(s, timeout=30)
+                by_status[str(rep["response"])] += 1
+                if rep["response"] == "shed":
+                    # the hint is both a dict field and parseable out
+                    # of the plain err text a v1/v2 client would see
+                    assert rep["retry_after_ms"] >= 25
+                    assert parse_retry_after(str(rep["result"])) \
+                        == rep["retry_after_ms"]
+            # 56 shed at the door; the safe op then pushed the queue
+            # one PAST the cap, so the drain shed exactly the ONE
+            # excess unsafe op (newest first) — the 7 the door had
+            # legitimately admitted still execute, and every refused
+            # op got a nack reply, none went dark
+            assert by_status["shed"] == 57
+            assert by_status["ok"] == 7
+            assert by_status["err"] == 0
+            assert c.wait(safe_seq, timeout=30)["response"] == "su"
+
+            # below the cap the same unsafe traffic is served normally
+            ok_seqs = c.send_batch("pnc", ["acct"],
+                                   np.zeros(4, np.int32), "i",
+                                   p0=np.ones(4, np.int64))
+            pump(12)
+            assert all(c.wait(s, timeout=30)["response"] == "ok"
+                       for s in ok_seqs)
+
+            # ledger reconciliation, as deltas across the flood
+            d_off = d_adm = d_shed = 0
+            for w, (off0, adm0, shed0, by0) in zip(svc.workers, led0):
+                off1, adm1, shed1, by1 = _ledger(w)
+                d_off += off1 - off0
+                d_adm += adm1 - adm0
+                d_shed += shed1 - shed0
+                assert by1["safe"] == by0["safe"]
+                assert by1["stable"] == by0["stable"]
+            assert d_shed == 57
+            # the flood delta reconciles: 69 offered = 12 admitted
+            # (7 drained unsafe + safe op + 4 served below-cap) + 57
+            # shed (56 at the door + 1 over-cap excess at the drain)
+            assert d_off == 69 and d_adm == 12
+            # (deltas, not cumulative values: the ledger counters live
+            # in the process-global registry, which other tests in the
+            # same pytest process also feed)
+            assert d_off == d_adm + d_shed
+
+            # request_with_retry honors the hint: two synthetic sheds
+            # then success — three requests, final reply is the ok one
+            replies = [
+                {"seq": 1, "response": "shed", "retry_after_ms": 10,
+                 "result": "shed: retry_after_ms=10"},
+                {"seq": 2, "response": "shed", "retry_after_ms": 10,
+                 "result": "shed: retry_after_ms=10"},
+                {"seq": 3, "response": "ok", "result": "65"},
+            ]
+            calls = []
+
+            def fake_request(*a, **k):
+                calls.append(a)
+                return replies[min(len(calls) - 1, len(replies) - 1)]
+
+            c.request = fake_request
+            t0 = time.monotonic()
+            rep = c.request_with_retry("pnc", "acct", "i", ["1"],
+                                       retries=8, backoff_cap_ms=40)
+            assert rep["response"] == "ok" and len(calls) == 3
+            assert time.monotonic() - t0 >= 0.01  # slept the hints
+            # exhausted retries hand back the final shed reply
+            calls.clear()
+            always_shed = dict(replies[0])
+
+            def fake_request_shed(*a, **k):
+                calls.append(a)
+                return always_shed
+
+            c.request = fake_request_shed
+            rep = c.request_with_retry("pnc", "acct", "i", ["1"],
+                                       retries=2, backoff_cap_ms=20)
+            assert rep["response"] == "shed" and len(calls) == 3
+    finally:
+        svc.stop()

@@ -175,3 +175,109 @@ def test_resize_block_shrink_refused_while_tail_live(small_kv):
 def test_resize_block_noop_same_size(small_kv):
     kv = small_kv
     assert kv.resize_block(kv.B)
+
+
+# -- SLO mode: the shed/wait overload laws --------------------------------
+
+def _slo_sched(**kw):
+    cfg = SchedulerConfig(b_min=64, b_max=1024, window=8, adjust_every=1,
+                          slo_p99_target_ms=100.0, wait0_ms=10.0,
+                          wait_min_ms=1.0, wait_max_ms=50.0, **kw)
+    return AdaptiveTick(cfg, b0=256, registry=Registry())
+
+
+def _slo_tick(s, goodput, p99, depth):
+    s.observe(0, 1.0)
+    s.observe_slo(goodput, p99, depth)
+    s.maybe_adjust()
+
+
+def test_slo_overload_grows_shed_and_pins_wait():
+    s = _slo_sched()
+    _slo_tick(s, goodput=1000.0, p99=500.0, depth=1.2)
+    assert s.shed_prob == pytest.approx(0.05)
+    assert s.wait_ms == 50.0            # deep queue: batching is free
+    # sustained overload: multiplicative ascent, capped at shed_max
+    seen = [s.shed_prob]
+    for _ in range(20):
+        _slo_tick(s, goodput=1000.0, p99=500.0, depth=1.2)
+        seen.append(s.shed_prob)
+    assert seen == sorted(seen)          # monotone under sustained load
+    assert seen[-1] == pytest.approx(0.95)  # and never past the ceiling
+
+
+def test_slo_deep_queue_alone_sheds_before_p99_breach():
+    # depth >= 1.0 sheds even while p99 still looks fine: the queue
+    # will become latency next window
+    s = _slo_sched()
+    _slo_tick(s, goodput=1000.0, p99=5.0, depth=1.5)
+    assert s.shed_prob > 0.0
+
+
+def test_slo_goodput_guard_backs_shed_off_during_collapse():
+    s = _slo_sched()
+    # establish a healthy goodput peak first
+    for _ in range(3):
+        _slo_tick(s, goodput=1000.0, p99=5.0, depth=0.0)
+    # overload arrives WITH collapsed goodput (< 90% of peak): growing
+    # shed would trade throughput for nothing, so the law backs off —
+    # from zero it stays at zero
+    _slo_tick(s, goodput=500.0, p99=500.0, depth=1.2)
+    assert s.shed_prob == 0.0
+    assert s.wait_ms == 50.0             # hold-off still pins long
+    # goodput back near peak: the ascent resumes
+    _slo_tick(s, goodput=990.0, p99=500.0, depth=1.2)
+    assert s.shed_prob == pytest.approx(0.05)
+    # ramp shed up while goodput holds, then collapse goodput: the law
+    # must DECREASE shed (AIMD seeking the plateau), not pin it high —
+    # a held overshoot is a permanent goodput collapse
+    for _ in range(6):
+        _slo_tick(s, goodput=990.0, p99=500.0, depth=1.2)
+    high = s.shed_prob
+    assert high > 0.5
+    _slo_tick(s, goodput=400.0, p99=500.0, depth=1.2)
+    assert s.shed_prob == pytest.approx(high * 0.7)
+    # sustained collapse keeps backing off until goodput recovers
+    for _ in range(20):
+        _slo_tick(s, goodput=400.0, p99=500.0, depth=1.2)
+    assert s.shed_prob == 0.0
+
+
+def test_slo_shallow_slow_shrinks_wait_instead_of_shedding():
+    s = _slo_sched()
+    _slo_tick(s, goodput=1000.0, p99=500.0, depth=1.2)  # seed shed/wait
+    assert s.wait_ms == 50.0
+    # p99 past target but the queue is shallow: the hold-off IS the
+    # latency — halve it toward the floor and decay shed instead
+    waits = []
+    for _ in range(8):
+        _slo_tick(s, goodput=1000.0, p99=500.0, depth=0.1)
+        waits.append(s.wait_ms)
+    assert waits == sorted(waits, reverse=True)
+    assert waits[-1] == 1.0              # at wait_min
+    assert s.shed_prob == 0.0            # decayed below 0.02 -> snapped
+
+
+def test_slo_healthy_decays_shed_and_relaxes_wait():
+    s = _slo_sched()
+    for _ in range(4):
+        _slo_tick(s, goodput=1000.0, p99=500.0, depth=1.2)
+    assert s.shed_prob > 0.0 and s.wait_ms == 50.0
+    for _ in range(12):
+        _slo_tick(s, goodput=1000.0, p99=5.0, depth=0.0)
+    assert s.shed_prob == 0.0
+    # wait converges halfway per tick back to the operating point
+    assert abs(s.wait_ms - 10.0) < 0.5
+
+
+def test_slo_laws_inert_without_target():
+    # slo_p99_target_ms = 0 (the default): observe_slo evidence is
+    # accepted but the shed/wait laws never engage — pre-overload
+    # deployments keep the plain block-size controller
+    cfg = SchedulerConfig(b_min=64, b_max=1024, window=8, adjust_every=1,
+                          wait0_ms=10.0)
+    s = AdaptiveTick(cfg, b0=256, registry=Registry())
+    for _ in range(5):
+        _slo_tick(s, goodput=1000.0, p99=500.0, depth=2.0)
+    assert s.shed_prob == 0.0
+    assert s.wait_ms == 10.0

@@ -30,9 +30,12 @@ def test_smoke_matrix_all_presets(tmp_path):
 
     rows = [json.loads(line) for line in out.read_text().splitlines()]
     # + the flight-overhead row + the SLO-plane row + the anatomy row
-    assert len(rows) == len(PRESETS) + 3
+    # + the overload-control row
+    assert len(rows) == len(PRESETS) + 4
     by_run = {r["run"]: r for r in rows}
     for name in PRESETS:
+        if name == "overload":
+            continue   # dedicated row + assertions below
         row = by_run[f"smoke_{name}"]
         smoke = row["smoke"]
         # telemetry was actually live (rga replays through jit_tick
@@ -117,3 +120,30 @@ def test_smoke_matrix_all_presets(tmp_path):
     assert set(mt["clock"]) == {"h0", "h1"}
     for peer in mt["clock"].values():
         assert peer["rtt_ns"] > 0
+    # overload control (run_smoke gates these; re-assert the row shape):
+    # the offered-load sweep engaged admission control at 1x and at a
+    # deep point far past true capacity, goodput held (plateau, not
+    # collapse) past saturation, every point reconciled
+    # offered == admitted + shed exactly, safe/stable ops were never
+    # shed, the pipeline never stalled, and the controller's own cost
+    # stayed under the telemetry budget
+    ovl = by_run["smoke_overload"]
+    ov = ovl["overload_report"]
+    sweep = {p["mult"]: p for p in ov["sweep"]}
+    deep = max(sweep)
+    assert set(sweep) == {1.0, deep} and deep > 1.0
+    assert ovl["smoke"]["deep_mult"] == deep
+    assert ovl["smoke"]["goodput_ratio"] >= 0.9
+    assert ovl["smoke"]["points_reconciled"] == len(sweep)
+    for p in ov["sweep"]:
+        assert p["offered"] == p["admitted"] + p["shed"]
+        assert p["commit_stalls"] == 0
+    # the deep point actually overloaded the door: something was shed
+    # and the nacks reached live clients (the drain threads may trail
+    # the server ledger by a scan, so bound rather than demand equality)
+    assert sweep[deep]["shed"] > 0
+    assert 0 < sweep[deep]["client_shed_replies"] <= sweep[deep]["shed"]
+    assert ov["safe_shed_total"] == 0
+    assert ov["stable_shed_total"] == 0
+    assert ov["goodput_plateau_frac"] >= 0.0
+    assert ovl["smoke"]["controller_overhead_frac_max"] < 0.02
